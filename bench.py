@@ -1,0 +1,109 @@
+"""Benchmark: MNIST ConvNet data-parallel training throughput on TPU.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "images/sec/chip", "vs_baseline": N}
+
+The reference publishes no numbers (BASELINE.md) — its deployed config is the
+MNIST ConvNet on CPU-only K8s pods (2 CPU / 4 Gi per worker,
+``tensorflow-mnist.yaml:49-53``). ``vs_baseline`` is therefore measured
+against a CPU run of the same train step on this host (the reference-hardware
+stand-in), per chip.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, REPO)
+
+
+def measure(batch_size: int, steps: int, warmup: int, dtype: str) -> float:
+    """Images/sec of the jitted DP train step on the current backend."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from k8s_distributed_deeplearning_tpu.models import mnist
+    from k8s_distributed_deeplearning_tpu.parallel import data_parallel as dp
+    from k8s_distributed_deeplearning_tpu.parallel import mesh as mesh_lib
+    from k8s_distributed_deeplearning_tpu.train import data as data_lib
+
+    mesh = mesh_lib.make_mesh({"data": -1})
+    model = mnist.MNISTConvNet(
+        dtype=jnp.bfloat16 if dtype == "bfloat16" else jnp.float32)
+    rng = jax.random.key(0)
+    params = model.init(rng, jnp.zeros((1, 28, 28, 1)), train=False)["params"]
+    state = dp.init_state(dp.replicate(params, mesh), optax.adam(1e-3), mesh)
+    step = dp.make_train_step(lambda p, b, r: mnist.loss_fn(model, p, b, r),
+                              optax.adam(1e-3), mesh)
+
+    x, y = data_lib.synthetic_mnist(batch_size, seed=0)
+    batch = dp.shard_batch({"image": x, "label": y}, mesh)
+
+    for _ in range(warmup):
+        state, loss, _ = step(state, batch, rng)
+    # Fetch the VALUE, not just readiness: on relayed/remote backends
+    # block_until_ready can return before execution really finishes, which
+    # would flatter the number. float() forces the bytes to the host.
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, loss, _ = step(state, batch, rng)
+    final = float(loss)
+    dt = time.perf_counter() - t0
+    assert final == final, "NaN loss in benchmark"
+    return batch_size * steps / dt
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=30)
+    ap.add_argument("--warmup", type=int, default=5)
+    ap.add_argument("--batch-size", type=int, default=2048)
+    ap.add_argument("--cpu-baseline", action="store_true",
+                    help="internal: measure the CPU reference stand-in")
+    args = ap.parse_args()
+
+    if args.cpu_baseline:
+        # Reference deployed config: per-rank batch 100 (tensorflow_mnist.py:160),
+        # fp32, CPU pod. Print raw images/sec for the parent to read.
+        ips = measure(batch_size=100, steps=10, warmup=2, dtype="float32")
+        print(json.dumps({"cpu_images_per_sec": ips}))
+        return
+
+    import jax
+    n_chips = jax.device_count()
+    ips = measure(args.batch_size, args.steps, args.warmup, dtype="bfloat16")
+    per_chip = ips / n_chips
+
+    baseline = None
+    try:
+        env = dict(os.environ, JAX_PLATFORM_NAME="cpu")
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--cpu-baseline"],
+            capture_output=True, text=True, timeout=600, env=env, cwd=REPO)
+        for line in out.stdout.strip().splitlines():
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if "cpu_images_per_sec" in rec:
+                baseline = rec["cpu_images_per_sec"]
+    except Exception:
+        baseline = None
+
+    print(json.dumps({
+        "metric": "mnist_conv_dp_images_per_sec_per_chip",
+        "value": round(per_chip, 1),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(per_chip / baseline, 2) if baseline else None,
+    }))
+
+
+if __name__ == "__main__":
+    main()

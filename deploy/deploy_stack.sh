@@ -1,0 +1,57 @@
+#!/usr/bin/env bash
+# One-shot cluster bring-up — the reference deploy_stack.sh, TPU-native.
+#
+# Reference flow (deploy_stack.sh:1-103): namespaces -> Loki helm release
+# (grafana+promtail, 5Gi persistence) -> MPI Operator -> inline MPIJob.
+# Here: same observability stack (identical helm chart+values — that layer is
+# infra config in both systems), no operator install at all (TPUJob renders to
+# core batch/v1 objects), and the reference's CRD race (apply at :38 not waited
+# before the job at :46) has no analog — but we still `kubectl wait` the
+# namespace and Loki release before launching the job.
+set -euo pipefail
+
+NAMESPACE="${NAMESPACE:-ml-ops}"
+LOKI_NAMESPACE="${LOKI_NAMESPACE:-loki}"
+WORKERS="${WORKERS:-2}"
+IMAGE="${IMAGE:-k8s-distributed-deeplearning-tpu:latest}"
+TPU_TOPOLOGY="${TPU_TOPOLOGY:-2x4}"
+REPO_ROOT="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+cd "$REPO_ROOT"  # python -m needs the package importable from cwd
+
+echo "==> Namespaces"
+kubectl create namespace "$NAMESPACE" --dry-run=client -o yaml | kubectl apply -f -
+kubectl create namespace "$LOKI_NAMESPACE" --dry-run=client -o yaml | kubectl apply -f -
+
+echo "==> Grafana Loki stack (logs + dashboards)"
+helm repo add grafana https://grafana.github.io/helm-charts >/dev/null 2>&1 || true
+helm repo update >/dev/null
+# Same chart and values as the reference (deploy_stack.sh:25-31).
+helm upgrade --install loki grafana/loki-stack \
+  --namespace "$LOKI_NAMESPACE" \
+  --set grafana.enabled=true \
+  --set promtail.enabled=true \
+  --set loki.persistence.enabled=true \
+  --set loki.persistence.size=5Gi \
+  --wait --timeout 10m
+
+echo "==> Grafana dashboard configmap"
+kubectl create configmap tpu-training-dashboard \
+  --namespace "$LOKI_NAMESPACE" \
+  --from-file="$REPO_ROOT/deploy/grafana-dashboard.json" \
+  --dry-run=client -o yaml | kubectl apply -f -
+kubectl label configmap tpu-training-dashboard \
+  --namespace "$LOKI_NAMESPACE" grafana_dashboard=1 --overwrite
+
+echo "==> TPUJob (${WORKERS} workers, topology ${TPU_TOPOLOGY})"
+python -m k8s_distributed_deeplearning_tpu.launch render \
+  --name tpu-mnist --namespace "$NAMESPACE" --workers "$WORKERS" \
+  --image "$IMAGE" --tpu-topology "$TPU_TOPOLOGY" \
+  --script examples/train_mnist.py -- --num-steps 20000 --dtype bfloat16 \
+  | kubectl apply -f -
+
+echo "==> Waiting for worker pods"
+kubectl wait --namespace "$NAMESPACE" --for=condition=Ready pod \
+  -l app=tpu-mnist --timeout=15m || true
+
+echo "Done. Logs: kubectl logs -n $NAMESPACE -l app=tpu-mnist -f"
+echo "Grafana: kubectl port-forward -n $LOKI_NAMESPACE svc/loki-grafana 3000:80"

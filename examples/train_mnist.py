@@ -1,0 +1,138 @@
+"""Distributed MNIST training — the TPU-native ``tensorflow_mnist.py``.
+
+Single-program, rank-parameterized: the same script runs on every host of the
+slice (parity with the reference where mpirun launches one copy per rank,
+``deploy_stack.sh:64-84``); the K8s-injected env wires the world
+(``parallel/distributed.py``), the device mesh replaces the MPI communicator,
+and all per-step communication is XLA collectives on ICI.
+
+Flags are the reference's (``tensorflow_mnist.py:30-35``,
+``tensorflow_mnist_gpu.py:36``): --lr, --num-steps, --use-adasum, --batch-size.
+
+Run single-host:   python examples/train_mnist.py --num-steps 200
+Fake an 8-chip DP mesh on CPU:
+  JAX_PLATFORM_NAME=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      python examples/train_mnist.py --num-steps 100
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from k8s_distributed_deeplearning_tpu import config as cfg
+from k8s_distributed_deeplearning_tpu.models import mnist
+from k8s_distributed_deeplearning_tpu.parallel import (
+    data_parallel as dp,
+    distributed,
+    mesh as mesh_lib,
+)
+from k8s_distributed_deeplearning_tpu.train import (
+    Checkpointer,
+    ShardedBatcher,
+    data as data_lib,
+    loop,
+)
+from k8s_distributed_deeplearning_tpu.utils.metrics import MetricsLogger
+
+
+def main(argv: list[str] | None = None) -> dict:
+    parser = argparse.ArgumentParser(description=__doc__)
+    cfg.add_train_flags(parser)
+    args = parser.parse_args(argv)
+    conf = cfg.train_config_from_args(args)
+
+    # Form the multi-host world before any device use (hvd.init() parity,
+    # tensorflow_mnist.py:90).
+    distributed.initialize_from_env()
+    topo = mesh_lib.topology()
+    mesh = mesh_lib.make_mesh({mesh_lib.AXIS_DATA: -1})
+    world = topo.world_size
+
+    dtype = jnp.bfloat16 if conf.dtype == "bfloat16" else jnp.float32
+    model = mnist.MNISTConvNet(dropout_rate=conf.dropout, dtype=dtype)
+
+    # LR × world (or Adasum rule) and steps ÷ world — tensorflow_mnist.py:123-130,146.
+    lr = conf.scaled_lr(world, topo.local_size,
+                        mesh_lib.fast_interconnect_available())
+    num_steps = conf.steps_for_world(world)
+    optimizer = optax.adam(lr)
+    reduction = dp.Reduction.ADASUM if conf.use_adasum else dp.Reduction.AVERAGE
+
+    rng = jax.random.key(conf.seed)
+    rng, init_rng = jax.random.split(rng)
+    params = model.init(init_rng, jnp.zeros((1, 28, 28, 1)), train=False)["params"]
+    params = dp.replicate(params, mesh)
+    # Broadcast initial state from replica 0 (BroadcastGlobalVariablesHook(0)
+    # parity, :143). Identical-seed SPMD already guarantees this; the explicit
+    # collective guards against host divergence.
+    params = dp.broadcast_params(params, mesh)
+    state = dp.init_state(params, optimizer, mesh)
+
+    step_fn = dp.make_train_step(
+        lambda p, b, r: mnist.loss_fn(model, p, b, r),
+        optimizer, mesh, reduction=reduction)
+
+    images, labels = data_lib.load_or_synthesize(conf.data_dir, "train",
+                                                 seed=conf.seed)
+    # Per-host batch = per-replica batch × local replicas; global = × world.
+    local_replicas = topo.num_devices // topo.num_processes
+    batcher = ShardedBatcher(images, labels,
+                             batch_size=conf.batch_size * local_replicas,
+                             seed=conf.seed,
+                             process_index=topo.process_index,
+                             num_processes=topo.num_processes)
+
+    metrics = MetricsLogger(enabled=distributed.is_primary(), job="mnist")
+    ckpt = Checkpointer(conf.checkpoint_dir,
+                        max_to_keep=conf.max_checkpoints_to_keep)
+    metrics.emit("start", world_size=world, num_steps=num_steps, lr=lr,
+                 reduction=reduction.value, platform=topo.platform,
+                 device_kind=topo.device_kind)
+
+    # Assemble host-local batches into global sharded arrays (multi-host
+    # safe); resumable from any step for replay-free checkpoint restore.
+    def global_batches(start_step: int):
+        return (dp.make_global_batch(b, mesh)
+                for b in batcher.iter_from(start_step))
+
+    state = loop.fit(
+        step_fn, state, global_batches, num_steps, rng,
+        metrics=metrics, checkpointer=ckpt,
+        checkpoint_every=conf.checkpoint_every, log_every=conf.log_every,
+        global_batch_size=conf.batch_size * world,
+        flops_per_example=mnist.flops_per_example(),
+        peak_flops=mesh_lib.peak_flops_per_device(conf.dtype),
+    )
+
+    result: dict = {"num_steps": num_steps, "world_size": world}
+    if conf.eval_final:
+        # Every process runs eval (params live on the global mesh, so all
+        # processes must participate in the jitted computation); identical
+        # replicated inputs on each host; only the primary emits/reports —
+        # the rank-0 discipline of tensorflow_mnist_gpu.py:184-188.
+        test_x, test_y = data_lib.load_or_synthesize(conf.data_dir, "test",
+                                                     seed=conf.seed)
+        eval_step = jax.jit(lambda p, b: mnist.eval_fn(model, p, b))
+        n = min(len(test_x), 2000)
+        bs = 200
+        ev = loop.evaluate(eval_step, state.params,
+                           iter(ShardedBatcher(test_x[:n], test_y[:n], bs,
+                                               seed=conf.seed)),
+                           num_batches=max(1, n // bs))
+        metrics.emit("eval", **{k: float(v) for k, v in ev.items()})
+        if distributed.is_primary():
+            result.update(ev)
+    ckpt.close()
+    metrics.close()
+    return result
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

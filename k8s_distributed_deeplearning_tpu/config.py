@@ -1,0 +1,140 @@
+"""Typed configuration + CLI flag surface.
+
+Parity: the reference exposes argparse flags ``--use-adasum``, ``--lr``,
+``--num-steps`` (reference ``horovod/tensorflow_mnist.py:30-35``) and
+``--batch-size`` (``horovod/tensorflow_mnist_gpu.py:36``); infra knobs are
+shell vars (``deploy_stack.sh:8-10``). Here everything is a typed dataclass
+with an argparse bridge, so the same config drives scripts, tests and the
+manifest renderer.
+"""
+from __future__ import annotations
+
+import argparse
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Any
+
+
+@dataclass
+class TrainConfig:
+    """Training hyper-parameters (reference defaults preserved).
+
+    Defaults mirror the deployed TF1 path: lr=0.001
+    (``tensorflow_mnist.py:33``), num_steps=20000 (``:34``), per-rank batch
+    size 100 (``:160-161``), Adasum off by default (``:31-32``).
+    """
+
+    lr: float = 0.001
+    num_steps: int = 20000
+    batch_size: int = 100            # per-replica batch size
+    use_adasum: bool = False
+    seed: int = 0
+    log_every: int = 10              # LoggingTensorHook cadence (:148-149)
+    eval_final: bool = True          # improvement: reference TF1 path never evals
+    dropout: float = 0.5
+    dtype: str = "float32"           # compute dtype; "bfloat16" for TPU runs
+
+    # Checkpointing (reference: rank-0-only ./checkpoints, :157-159)
+    checkpoint_dir: str = "./checkpoints"
+    checkpoint_every: int = 1000
+    max_checkpoints_to_keep: int = 3
+
+    # Data
+    data_dir: str | None = None      # MNIST idx files; None -> synthetic
+
+    def scaled_lr(self, world_size: int, local_size: int = 1,
+                  fast_interconnect: bool = False) -> float:
+        """Horovod LR scaling rule (``tensorflow_mnist.py:123-130``).
+
+        Average reduction: lr × world_size. Adasum: lr × local_size when a
+        fast device interconnect handles the intra-node reduction (the
+        ``hvd.nccl_built()`` probe, ``:126-127``), else lr × 1.
+        """
+        if self.use_adasum:
+            return self.lr * (local_size if fast_interconnect else 1)
+        return self.lr * world_size
+
+    def steps_for_world(self, world_size: int) -> int:
+        """Total optimizer steps for this world size (``tensorflow_mnist.py:146``)."""
+        return self.num_steps // world_size
+
+
+@dataclass
+class MeshConfig:
+    """Logical device mesh. Axis sizes of -1 mean "fill with what's left"."""
+
+    data: int = -1       # data-parallel axis
+    fsdp: int = 1        # param-sharding (ZeRO/FSDP) axis
+    tensor: int = 1      # tensor-parallel axis
+    sequence: int = 1    # sequence/context-parallel axis
+    expert: int = 1      # expert-parallel axis (MoE)
+    pipeline: int = 1    # pipeline-parallel axis
+
+    def axis_names(self) -> tuple[str, ...]:
+        return ("data", "fsdp", "tensor", "sequence", "expert", "pipeline")
+
+    def to_axis_sizes(self) -> dict[str, int]:
+        """Axis-size mapping for ``parallel.mesh.make_mesh`` — size-1 axes are
+        dropped (they'd only pad the mesh shape), ``data`` always kept."""
+        sizes = {name: getattr(self, name) for name in self.axis_names()}
+        return {k: v for k, v in sizes.items() if v != 1 or k == "data"}
+
+
+@dataclass
+class JobConfig:
+    """Cluster job shape — the MPIJob-manifest knobs (``tensorflow-mnist.yaml:6,44``,
+    ``deploy_stack.sh:8-10,57,90``) recast for TPU slices."""
+
+    name: str = "tpu-mnist"
+    namespace: str = "ml-ops"
+    num_workers: int = 2
+    tpu_topology: str = "2x4"        # e.g. v5e slice topology per worker
+    tpu_accelerator: str = "tpu-v5-lite-podslice"
+    image: str = "k8s-distributed-deeplearning-tpu:latest"
+    script: str = "examples/train_mnist.py"
+    script_args: list[str] = field(default_factory=list)
+    cpu: str = "2"                   # worker resources (tensorflow-mnist.yaml:49-53)
+    memory: str = "4Gi"
+    coordinator_port: int = 8476
+    clean_pod_policy: str = "Running"  # tensorflow-mnist.yaml:8
+    tpu_chips_per_worker: int | None = None  # None -> derived from topology
+
+    def chips_per_worker(self) -> int:
+        """TPU chips each pod must request: the slice's chip total (product of
+        the topology dims) split across the worker pods. GKE schedules one pod
+        per TPU host and requires it to claim all of that host's chips."""
+        if self.tpu_chips_per_worker is not None:
+            return self.tpu_chips_per_worker
+        chips = 1
+        for d in self.tpu_topology.split("x"):
+            chips *= int(d)
+        return max(1, chips // max(1, self.num_workers))
+
+
+def add_train_flags(parser: argparse.ArgumentParser,
+                    defaults: TrainConfig | None = None) -> None:
+    """Attach the reference's CLI surface (plus framework extras) to *parser*."""
+    d = defaults or TrainConfig()
+    parser.add_argument("--use-adasum", action="store_true", default=d.use_adasum,
+                        help="use Adasum gradient reduction instead of averaging")
+    parser.add_argument("--lr", type=float, default=d.lr,
+                        help="base learning rate (scaled by world size)")
+    parser.add_argument("--num-steps", type=int, default=d.num_steps,
+                        help="total step budget, divided by world size")
+    parser.add_argument("--batch-size", type=int, default=d.batch_size,
+                        help="per-replica batch size")
+    parser.add_argument("--seed", type=int, default=d.seed)
+    parser.add_argument("--log-every", type=int, default=d.log_every)
+    parser.add_argument("--checkpoint-dir", type=str, default=d.checkpoint_dir)
+    parser.add_argument("--checkpoint-every", type=int, default=d.checkpoint_every)
+    parser.add_argument("--data-dir", type=str, default=d.data_dir)
+    parser.add_argument("--dtype", type=str, default=d.dtype,
+                        choices=["float32", "bfloat16"])
+    parser.add_argument("--no-eval", dest="eval_final", action="store_false",
+                        default=d.eval_final)
+
+
+def train_config_from_args(args: argparse.Namespace) -> TrainConfig:
+    known = {f.name for f in dataclasses.fields(TrainConfig)}
+    kwargs: dict[str, Any] = {k: v for k, v in vars(args).items() if k in known}
+    return TrainConfig(**kwargs)

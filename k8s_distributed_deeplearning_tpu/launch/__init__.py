@@ -1,0 +1,7 @@
+"""TPUJob orchestration: manifest rendering + cluster bring-up."""
+
+from k8s_distributed_deeplearning_tpu.launch.render import (  # noqa: F401
+    render_tpujob,
+    render_all,
+    to_yaml,
+)

@@ -1,0 +1,63 @@
+"""CLI: render (and optionally apply) TPUJob manifests.
+
+Usage:
+  python -m k8s_distributed_deeplearning_tpu.launch render --workers 4 \
+      --name tpu-mnist --script examples/train_mnist.py -- --num-steps 20000
+  python -m k8s_distributed_deeplearning_tpu.launch render ... --apply
+
+The ``--apply`` path shells to kubectl like ``deploy_stack.sh:46`` does, but
+waits for the namespace first (fixing the reference's CRD-not-ready race,
+``deploy_stack.sh:38,46``; here there is no CRD at all — core Job objects).
+"""
+from __future__ import annotations
+
+import argparse
+import subprocess
+import sys
+
+from k8s_distributed_deeplearning_tpu.config import JobConfig
+from k8s_distributed_deeplearning_tpu.launch import render
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    script_args: list[str] = []
+    if "--" in argv:
+        i = argv.index("--")
+        argv, script_args = argv[:i], argv[i + 1:]
+
+    ap = argparse.ArgumentParser(prog="launch")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    r = sub.add_parser("render", help="render TPUJob manifests to stdout")
+    d = JobConfig()
+    r.add_argument("--name", default=d.name)
+    r.add_argument("--namespace", default=d.namespace)
+    r.add_argument("--workers", type=int, default=d.num_workers)
+    r.add_argument("--image", default=d.image)
+    r.add_argument("--script", default=d.script)
+    r.add_argument("--tpu-topology", default=d.tpu_topology)
+    r.add_argument("--tpu-accelerator", default=d.tpu_accelerator)
+    r.add_argument("--cpu", default=d.cpu)
+    r.add_argument("--memory", default=d.memory)
+    r.add_argument("--apply", action="store_true",
+                   help="pipe the manifests into kubectl apply -f -")
+    args = ap.parse_args(argv)
+
+    cfg = JobConfig(name=args.name, namespace=args.namespace,
+                    num_workers=args.workers, image=args.image,
+                    script=args.script, script_args=script_args,
+                    tpu_topology=args.tpu_topology,
+                    tpu_accelerator=args.tpu_accelerator,
+                    cpu=args.cpu, memory=args.memory)
+    docs = render.render_all(cfg)
+    text = render.to_yaml(docs)
+    if not args.apply:
+        print(text)
+        return 0
+    proc = subprocess.run(["kubectl", "apply", "-f", "-"], input=text,
+                          text=True)
+    return proc.returncode
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,114 @@
+"""TPUJob manifest renderer — the MPI Operator + MPIJob CRD replacement.
+
+What the reference needs an operator *for* (``deploy_stack.sh:38``,
+``tensorflow-mnist.yaml``): gang-schedule 1 launcher + N workers, wire an SSH
+control channel (key Secret, hostfile, sshd tuning ``Dockerfile:68-78``), and
+have the launcher mpirun into every worker. On TPU none of that machinery is
+needed: every worker is identical (no launcher/worker asymmetry), the control
+channel is ``jax.distributed`` over DCN, and gang semantics come from a K8s
+**Indexed Job** + headless Service — pod index 0 is the coordinator, stable
+DNS names replace the hostfile, and env vars replace ``mpirun -x``
+(``deploy_stack.sh:73-76``). The whole operator collapses into a renderer.
+
+Capability parity map:
+- ``mpiReplicaSpecs.Worker.replicas`` (``tensorflow-mnist.yaml:44``)  -> Job completions/parallelism
+- SSH Secret + hostfile                                   -> headless Service DNS
+- ``mpirun -np N`` rank assignment                        -> JOB_COMPLETION_INDEX -> TPUJOB_PROCESS_ID
+- ``cleanPodPolicy: Running`` (``tensorflow-mnist.yaml:8``)   -> Job ttlSecondsAfterFinished + restartPolicy
+- resource limits (``tensorflow-mnist.yaml:39-53``)           -> container resources + google.com/tpu
+"""
+from __future__ import annotations
+
+import yaml
+
+from k8s_distributed_deeplearning_tpu.config import JobConfig
+
+
+def _coordinator_host(cfg: JobConfig) -> str:
+    # Indexed-Job pods get hostname <job>-<index> in the headless service's
+    # subdomain; index 0 is process 0 (the JAX coordinator).
+    return f"{cfg.name}-0.{cfg.name}.{cfg.namespace}"
+
+
+def render_namespace(cfg: JobConfig) -> dict:
+    return {"apiVersion": "v1", "kind": "Namespace",
+            "metadata": {"name": cfg.namespace}}
+
+
+def render_service(cfg: JobConfig) -> dict:
+    """Headless service giving workers stable DNS — the hostfile replacement."""
+    return {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {"name": cfg.name, "namespace": cfg.namespace,
+                     "labels": {"app": cfg.name}},
+        "spec": {
+            "clusterIP": "None",
+            "selector": {"job-name": cfg.name},
+            "ports": [{"name": "coordinator", "port": cfg.coordinator_port}],
+        },
+    }
+
+
+def render_tpujob(cfg: JobConfig) -> dict:
+    """The Indexed Job running one identical worker per TPU host."""
+    env = [
+        {"name": "TPUJOB_COORDINATOR_ADDRESS",
+         "value": f"{_coordinator_host(cfg)}:{cfg.coordinator_port}"},
+        {"name": "TPUJOB_NUM_PROCESSES", "value": str(cfg.num_workers)},
+        {"name": "TPUJOB_PROCESS_ID",
+         "valueFrom": {"fieldRef": {"fieldPath":
+             "metadata.annotations['batch.kubernetes.io/job-completion-index']"}}},
+        # Visibility for logs/metrics labels
+        {"name": "TPUJOB_NAME", "value": cfg.name},
+    ]
+    container = {
+        "name": "worker",
+        "image": cfg.image,
+        "command": ["python", cfg.script, *cfg.script_args],
+        "env": env,
+        "ports": [{"containerPort": cfg.coordinator_port}],
+        "resources": {
+            "requests": {"cpu": cfg.cpu, "memory": cfg.memory},
+            "limits": {"cpu": cfg.cpu, "memory": cfg.memory,
+                       "google.com/tpu": str(cfg.chips_per_worker())},
+        },
+    }
+    return {
+        "apiVersion": "batch/v1",
+        "kind": "Job",
+        "metadata": {"name": cfg.name, "namespace": cfg.namespace,
+                     "labels": {"app": cfg.name, "framework":
+                                "k8s-distributed-deeplearning-tpu"}},
+        "spec": {
+            "completions": cfg.num_workers,
+            "parallelism": cfg.num_workers,          # gang: all pods at once
+            "completionMode": "Indexed",
+            "backoffLimit": 3,
+            # cleanPodPolicy analog (tensorflow-mnist.yaml:8): "Running" (or
+            # "All") reaps finished pods via TTL; "None" keeps them around for
+            # post-mortem log inspection.
+            **({"ttlSecondsAfterFinished": 600}
+               if cfg.clean_pod_policy != "None" else {}),
+            "template": {
+                "metadata": {"labels": {"app": cfg.name}},
+                "spec": {
+                    "subdomain": cfg.name,           # joins the headless svc
+                    "restartPolicy": "OnFailure",
+                    "nodeSelector": {
+                        "cloud.google.com/gke-tpu-accelerator": cfg.tpu_accelerator,
+                        "cloud.google.com/gke-tpu-topology": cfg.tpu_topology,
+                    },
+                    "containers": [container],
+                },
+            },
+        },
+    }
+
+
+def render_all(cfg: JobConfig) -> list[dict]:
+    return [render_namespace(cfg), render_service(cfg), render_tpujob(cfg)]
+
+
+def to_yaml(docs: list[dict]) -> str:
+    return yaml.safe_dump_all(docs, sort_keys=False)

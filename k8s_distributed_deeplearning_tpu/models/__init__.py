@@ -1,0 +1,3 @@
+"""Model zoo."""
+
+from k8s_distributed_deeplearning_tpu.models.mnist import MNISTConvNet  # noqa: F401

@@ -1,0 +1,9 @@
+"""Collectives and TPU kernels."""
+
+from k8s_distributed_deeplearning_tpu.ops.collectives import (  # noqa: F401
+    tree_pmean,
+    tree_psum,
+    adasum_reduce,
+    broadcast_from,
+    tree_dot,
+)

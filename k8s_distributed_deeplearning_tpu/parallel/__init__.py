@@ -1,0 +1,16 @@
+"""Parallelism: meshes, shardings, the data-parallel engine, multi-host runtime."""
+
+from k8s_distributed_deeplearning_tpu.parallel.mesh import (  # noqa: F401
+    make_mesh,
+    topology,
+    fast_interconnect_available,
+)
+from k8s_distributed_deeplearning_tpu.parallel.distributed import (  # noqa: F401
+    initialize_from_env,
+    is_primary,
+)
+from k8s_distributed_deeplearning_tpu.parallel.data_parallel import (  # noqa: F401
+    Reduction,
+    make_train_step,
+    broadcast_params,
+)

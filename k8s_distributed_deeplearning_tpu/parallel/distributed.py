@@ -1,0 +1,80 @@
+"""Multi-host runtime bootstrap — the mpirun/OpenMPI/sshd replacement.
+
+The reference wires its process group with ``mpirun -np N`` over SSH between
+pods (``deploy_stack.sh:64-84``, ``Dockerfile:68-78``): mpirun sshes into each
+worker, spawns one python per rank, and MPI_Init inside ``hvd.init()``
+(``tensorflow_mnist.py:90``) forms the world. On TPU there is no mpirun and no
+SSH control channel: every pod runs the same script, the K8s controller (see
+``launch/render.py``) injects coordinator env vars, and
+``jax.distributed.initialize`` forms the world over DCN while XLA compiles the
+per-step collectives onto ICI.
+
+Env contract (what the rendered TPUJob manifest injects — also honors the
+standard JAX/GKE vars so plain JobSets work):
+
+- ``TPUJOB_COORDINATOR_ADDRESS``  host:port of process 0
+- ``TPUJOB_NUM_PROCESSES``        world size in processes
+- ``TPUJOB_PROCESS_ID``           this process's id (from the pod ordinal)
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+_INITIALIZED = False
+
+
+def _env(*names: str) -> str | None:
+    for n in names:
+        v = os.environ.get(n)
+        if v:
+            return v
+    return None
+
+
+def initialize_from_env() -> bool:
+    """Form the multi-host JAX world from env vars; no-op when single-process.
+
+    Returns True if ``jax.distributed.initialize`` was called. Safe to call
+    more than once (the ``hvd.init()`` call-site parity point,
+    ``tensorflow_mnist.py:90``). Must run before first device use — the moral
+    equivalent of the reference's "CRD must exist before the job applies" race
+    (``deploy_stack.sh:38,46``), fixed here by failing fast with a clear error.
+    """
+    global _INITIALIZED
+    if _INITIALIZED:
+        return True
+    coord = _env("TPUJOB_COORDINATOR_ADDRESS", "JAX_COORDINATOR_ADDRESS",
+                 "COORDINATOR_ADDRESS")
+    nproc = _env("TPUJOB_NUM_PROCESSES", "JAX_NUM_PROCESSES", "NUM_PROCESSES")
+    pid = _env("TPUJOB_PROCESS_ID", "JAX_PROCESS_ID", "PROCESS_ID")
+    if coord is None and nproc is None:
+        return False  # single-process (or TPU-VM auto-bootstrap) run
+    if coord is None or nproc is None or pid is None:
+        raise RuntimeError(
+            "Partial multi-host env: need TPUJOB_COORDINATOR_ADDRESS, "
+            f"TPUJOB_NUM_PROCESSES and TPUJOB_PROCESS_ID (got coord={coord!r}, "
+            f"nproc={nproc!r}, pid={pid!r}). The TPUJob manifest renderer "
+            "injects all three; see launch/render.py.")
+    if int(nproc) <= 1:
+        return False
+    jax.distributed.initialize(coordinator_address=coord,
+                               num_processes=int(nproc),
+                               process_id=int(pid))
+    _INITIALIZED = True
+    return True
+
+
+def is_primary() -> bool:
+    """True on process 0 — the ``hvd.rank() == 0`` gate used for checkpoints
+    and logging (``tensorflow_mnist.py:159``, ``tensorflow_mnist_gpu.py:157``)."""
+    return jax.process_index() == 0
+
+
+def process_index() -> int:
+    return jax.process_index()
+
+
+def process_count() -> int:
+    return jax.process_count()

@@ -1,0 +1,6 @@
+"""Bindings to the native C++ runtime (gradient fusion planner, probes)."""
+
+from k8s_distributed_deeplearning_tpu.runtime.fusion import (  # noqa: F401
+    FusionPlanner,
+    native_available,
+)

@@ -1,0 +1,9 @@
+"""Training loop, data pipeline, checkpointing."""
+
+from k8s_distributed_deeplearning_tpu.train.data import (  # noqa: F401
+    ShardedBatcher,
+    load_mnist,
+    synthetic_mnist,
+)
+from k8s_distributed_deeplearning_tpu.train.checkpoint import Checkpointer  # noqa: F401
+from k8s_distributed_deeplearning_tpu.train.loop import fit  # noqa: F401

@@ -1,0 +1,139 @@
+"""Data pipeline: deterministic, per-host-disjoint infinite batching.
+
+The reference's input is an infinite generator that *independently* shuffles
+the full MNIST set on every rank (``tensorflow_mnist.py:76-85,160-161``) —
+sharding by randomization, with per-rank dataset caches to dodge download
+races (``:109``, mkdir race workaround ``:97-105``). Here sharding is real:
+one global permutation per epoch (seeded, identical on every host), each
+process takes a disjoint stride slice, so the union over hosts covers the
+epoch exactly once and runs are reproducible. No shared-cache races by
+construction — nothing is downloaded (zero-egress: local idx files or a
+procedural synthetic set).
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+from typing import Iterator
+
+import numpy as np
+
+PyTree = dict
+
+
+def _open_maybe_gz(path: str):
+    if os.path.exists(path + ".gz"):
+        return gzip.open(path + ".gz", "rb")
+    return open(path, "rb")
+
+
+def _read_idx(path: str) -> np.ndarray:
+    """Parse an IDX file (the MNIST on-disk format)."""
+    with _open_maybe_gz(path) as f:
+        magic = struct.unpack(">I", f.read(4))[0]
+        ndim = magic & 0xFF
+        dims = struct.unpack(">" + "I" * ndim, f.read(4 * ndim))
+        data = np.frombuffer(f.read(), dtype=np.uint8)
+    return data.reshape(dims)
+
+
+def load_mnist(data_dir: str, split: str = "train") -> tuple[np.ndarray, np.ndarray]:
+    """Load MNIST idx files from *data_dir*; images in [0,1] float32, HWC."""
+    prefix = "train" if split == "train" else "t10k"
+    images = _read_idx(os.path.join(data_dir, f"{prefix}-images-idx3-ubyte"))
+    labels = _read_idx(os.path.join(data_dir, f"{prefix}-labels-idx1-ubyte"))
+    return images.astype(np.float32)[..., None] / 255.0, labels.astype(np.int32)
+
+
+def synthetic_mnist(num: int = 4096, seed: int = 0, noise: float = 0.25,
+                    sample_seed: int | None = None) -> tuple[np.ndarray, np.ndarray]:
+    """Procedural MNIST stand-in for zero-egress environments.
+
+    Ten fixed random 28×28 class templates + per-example Gaussian noise —
+    linearly separable enough that the parity ConvNet trains to high accuracy
+    fast, which is what tests and smoke runs need. ``seed`` fixes the class
+    templates (the "dataset"); ``sample_seed`` varies the drawn examples, so
+    train/test splits share templates but not samples.
+    """
+    tmpl_rng = np.random.default_rng(seed)
+    templates = tmpl_rng.normal(size=(10, 28, 28, 1)).astype(np.float32)
+    rng = np.random.default_rng(seed if sample_seed is None else sample_seed)
+    labels = rng.integers(0, 10, size=(num,)).astype(np.int32)
+    images = templates[labels] + noise * rng.normal(size=(num, 28, 28, 1)).astype(np.float32)
+    return images.astype(np.float32), labels
+
+
+def load_or_synthesize(data_dir: str | None, split: str = "train",
+                       synth_size: int = 4096, seed: int = 0):
+    """Real MNIST from *data_dir*, or the synthetic set when no dir is given.
+
+    An explicitly requested directory that doesn't exist is an error — never
+    silently train on fake data because a volume failed to mount.
+    """
+    if data_dir:
+        if not os.path.isdir(data_dir):
+            raise FileNotFoundError(
+                f"--data-dir {data_dir!r} does not exist; refusing to fall "
+                "back to synthetic data (omit --data-dir for synthetic)")
+        return load_mnist(data_dir, split)
+    return synthetic_mnist(synth_size if split == "train" else synth_size // 4,
+                           seed=seed,
+                           sample_seed=seed if split == "train" else seed + 10_000)
+
+
+class ShardedBatcher:
+    """Infinite iterator of per-host batches with true epoch sharding.
+
+    Parity surface: ``train_input_generator`` (``tensorflow_mnist.py:76-85``)
+    — infinite, shuffled, fixed batch size — but each host sees a disjoint
+    1/num_processes slice of every epoch (SURVEY.md §7 hard part (c)).
+
+    ``batch_size`` is the *per-host* batch (per-replica batch × local replica
+    count); the training step shards it across local devices.
+    """
+
+    def __init__(self, images: np.ndarray, labels: np.ndarray, batch_size: int,
+                 seed: int = 0, process_index: int = 0, num_processes: int = 1):
+        if batch_size <= 0:
+            raise ValueError("batch_size must be positive")
+        self.images, self.labels = images, labels
+        self.batch_size = batch_size
+        self.seed = seed
+        self.process_index = process_index
+        self.num_processes = num_processes
+
+    def shard_indices(self, epoch: int) -> np.ndarray:
+        """This host's disjoint, shuffled slice of the epoch."""
+        rng = np.random.default_rng((self.seed, epoch))
+        perm = rng.permutation(len(self.images))
+        return perm[self.process_index::self.num_processes]
+
+    @property
+    def batches_per_epoch(self) -> int:
+        n = len(self.shard_indices(0)) // self.batch_size
+        if n == 0:
+            raise ValueError(
+                f"per-host shard ({len(self.shard_indices(0))} examples) is "
+                f"smaller than batch_size={self.batch_size}")
+        return n
+
+    def batch_at(self, step: int) -> PyTree:
+        """The step-th batch of the deterministic schedule (stateless: any
+        step is addressable, which is what makes checkpoint resume replay-free
+        — fit() restarts the stream at the restored step). The sub-batch tail
+        of each epoch shard is dropped."""
+        bpe = self.batches_per_epoch
+        epoch, pos = divmod(step, bpe)
+        idx = self.shard_indices(epoch)
+        sel = idx[pos * self.batch_size:(pos + 1) * self.batch_size]
+        return {"image": self.images[sel], "label": self.labels[sel]}
+
+    def iter_from(self, start_step: int = 0) -> Iterator[PyTree]:
+        step = start_step
+        while True:
+            yield self.batch_at(step)
+            step += 1
+
+    def __iter__(self) -> Iterator[PyTree]:
+        return self.iter_from(0)

@@ -1,0 +1,3 @@
+"""Metrics, logging, misc utilities."""
+
+from k8s_distributed_deeplearning_tpu.utils.metrics import MetricsLogger  # noqa: F401

@@ -1,0 +1,74 @@
+"""Structured metrics — the Loki/Promtail/Grafana-facing surface.
+
+The reference's observability story (its signature feature, ``README.md:9-15``)
+is: apps print loss to stdout every 10 steps (``LoggingTensorHook``,
+``tensorflow_mnist.py:148-149``), Promtail tails pod stdout into Loki, Grafana
+queries Loki. The app side needs zero integration beyond *printing*.
+
+This module keeps that contract but emits **structured JSON lines** (one
+object per event) so Grafana/LogQL can parse fields instead of regexing free
+text — and adds the quantities the reference never measured (§6): step time,
+images/sec/chip, MFU. Cross-replica metric averaging happens inside the jitted
+train step via ``pmean`` (parity: ``MetricAverageCallback``,
+``tensorflow_mnist_gpu.py:153``), so what lands here is already global.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+from typing import Any, IO
+
+
+class MetricsLogger:
+    """Emit JSONL metric events to stdout (→ Promtail → Loki) and optionally a file.
+
+    Only the primary process should construct one with ``enabled=True`` — the
+    rank-0 logging discipline (``tensorflow_mnist.py:148-149,159``).
+    """
+
+    def __init__(self, enabled: bool = True, stream: IO[str] | None = None,
+                 path: str | None = None, job: str = "train"):
+        self.enabled = enabled
+        self.stream = stream if stream is not None else sys.stdout
+        self.job = job
+        self._file = open(path, "a") if (path and enabled) else None
+        self._t0 = time.monotonic()
+
+    def emit(self, event: str, **fields: Any) -> None:
+        if not self.enabled:
+            return
+        rec = {"event": event, "job": self.job,
+               "elapsed_s": round(time.monotonic() - self._t0, 3)}
+        for k, v in fields.items():
+            if hasattr(v, "item"):
+                v = v.item()
+            if isinstance(v, float):
+                v = round(v, 6)
+            rec[k] = v
+        line = json.dumps(rec)
+        print(line, file=self.stream, flush=True)
+        if self._file:
+            self._file.write(line + "\n")
+            self._file.flush()
+
+    def train_step(self, step: int, loss: float, step_time_ms: float,
+                   examples_per_sec: float, per_chip: float,
+                   mfu: float | None = None, **extra: Any) -> None:
+        self.emit("train_step", step=step, loss=loss, step_time_ms=step_time_ms,
+                  examples_per_sec=examples_per_sec,
+                  examples_per_sec_per_chip=per_chip,
+                  **({"mfu": mfu} if mfu is not None else {}), **extra)
+
+    def close(self) -> None:
+        if self._file:
+            self._file.close()
+            self._file = None
+
+
+def mfu(flops_per_example: float, examples_per_sec: float, num_devices: int,
+        peak_flops_per_device: float) -> float:
+    """Model FLOPs utilization: achieved model FLOP/s over peak hardware FLOP/s."""
+    if peak_flops_per_device <= 0 or num_devices <= 0:
+        return 0.0
+    return (flops_per_example * examples_per_sec) / (peak_flops_per_device * num_devices)

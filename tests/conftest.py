@@ -1,0 +1,42 @@
+"""Test harness: fake an 8-device TPU mesh on CPU.
+
+The JAX-native "fake backend" (SURVEY.md §4): ``xla_force_host_platform_device_count``
+gives N CpuDevices so every collective, sharding rule, and rank-gating branch
+runs in CI without hardware. ``JAX_PLATFORM_NAME`` (not JAX_PLATFORMS — the
+environment's TPU boot hook re-pins that) forces the CPU backend.
+
+Must run before jax initializes a backend, hence top-of-conftest.
+"""
+import os
+import sys
+
+os.environ["JAX_PLATFORM_NAME"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+# The environment's TPU boot hook (sitecustomize) imports jax at interpreter
+# start and re-pins JAX_PLATFORMS, so env vars alone are too late under pytest
+# — pin the platform on the already-imported config too, and deregister the
+# TPU plugin's backend factory entirely: otherwise jax initializes it even for
+# CPU runs, and a wedged TPU tunnel then hangs every test process. XLA_FLAGS
+# is read at CPU client creation, which hasn't happened yet at conftest-import
+# time.
+import jax  # noqa: E402
+import jax._src.xla_bridge as _xb  # noqa: E402
+
+_xb._backend_factories.pop("axon", None)
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_platform_name", "cpu")
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    from k8s_distributed_deeplearning_tpu.parallel import mesh as mesh_lib
+    return mesh_lib.make_mesh({"data": -1})

@@ -1,0 +1,35 @@
+"""Checkpoint save / restore-on-start roundtrip (MonitoredTrainingSession parity)."""
+import jax.numpy as jnp
+import numpy as np
+
+from k8s_distributed_deeplearning_tpu.train.checkpoint import Checkpointer
+
+
+def _state(val):
+    return {"params": {"w": jnp.full((3, 2), val)}, "step": jnp.asarray(7)}
+
+
+def test_roundtrip(tmp_path):
+    ckpt = Checkpointer(str(tmp_path / "ck"), max_to_keep=2)
+    ckpt.save(10, _state(1.5))
+    restored, step = ckpt.restore_latest(_state(0.0))
+    assert step == 10
+    np.testing.assert_allclose(restored["params"]["w"], np.full((3, 2), 1.5))
+    ckpt.close()
+
+
+def test_restore_empty_returns_none(tmp_path):
+    ckpt = Checkpointer(str(tmp_path / "empty"))
+    assert ckpt.restore_latest(_state(0.0)) is None
+    ckpt.close()
+
+
+def test_latest_wins_and_max_to_keep(tmp_path):
+    ckpt = Checkpointer(str(tmp_path / "ck"), max_to_keep=2)
+    for s, v in [(1, 1.0), (2, 2.0), (3, 3.0)]:
+        ckpt.save(s, _state(v))
+    restored, step = ckpt.restore_latest(_state(0.0))
+    assert step == 3
+    np.testing.assert_allclose(restored["params"]["w"], np.full((3, 2), 3.0))
+    assert ckpt.latest_step() == 3
+    ckpt.close()

@@ -1,0 +1,73 @@
+"""Data pipeline: disjoint per-host sharding, determinism, idx parsing."""
+import gzip
+import os
+import struct
+
+import numpy as np
+
+from k8s_distributed_deeplearning_tpu.train import data as data_lib
+
+
+def test_shards_are_disjoint_and_cover_epoch():
+    x, y = data_lib.synthetic_mnist(100, seed=0)
+    shards = [
+        data_lib.ShardedBatcher(x, y, 10, seed=7, process_index=i,
+                                num_processes=4).shard_indices(epoch=0)
+        for i in range(4)
+    ]
+    union = np.concatenate(shards)
+    assert sorted(union.tolist()) == list(range(100))
+    for i in range(4):
+        for j in range(i + 1, 4):
+            assert not set(shards[i]) & set(shards[j])
+
+
+def test_epoch_permutations_differ_but_are_deterministic():
+    x, y = data_lib.synthetic_mnist(64, seed=0)
+    b = data_lib.ShardedBatcher(x, y, 8, seed=3)
+    e0a, e0b = b.shard_indices(0), b.shard_indices(0)
+    e1 = b.shard_indices(1)
+    np.testing.assert_array_equal(e0a, e0b)
+    assert not np.array_equal(e0a, e1)
+
+
+def test_infinite_iteration_and_batch_shape():
+    x, y = data_lib.synthetic_mnist(50, seed=0)
+    it = iter(data_lib.ShardedBatcher(x, y, 16, seed=0))
+    for _ in range(10):  # > one epoch: generator must roll over (parity with
+        batch = next(it)  # the reference's infinite generator, :76-85)
+        assert batch["image"].shape == (16, 28, 28, 1)
+        assert batch["label"].shape == (16,)
+
+
+def test_idx_roundtrip(tmp_path):
+    imgs = np.arange(2 * 28 * 28, dtype=np.uint8).reshape(2, 28, 28)
+    labels = np.array([3, 7], dtype=np.uint8)
+    with gzip.open(os.path.join(tmp_path, "train-images-idx3-ubyte.gz"), "wb") as f:
+        f.write(struct.pack(">I", 0x00000803) + struct.pack(">III", 2, 28, 28)
+                + imgs.tobytes())
+    with gzip.open(os.path.join(tmp_path, "train-labels-idx1-ubyte.gz"), "wb") as f:
+        f.write(struct.pack(">I", 0x00000801) + struct.pack(">I", 2)
+                + labels.tobytes())
+    x, y = data_lib.load_mnist(str(tmp_path), "train")
+    assert x.shape == (2, 28, 28, 1) and x.max() <= 1.0
+    np.testing.assert_array_equal(y, [3, 7])
+
+
+def test_load_or_synthesize_falls_back():
+    x, y = data_lib.load_or_synthesize(None, "train", synth_size=32)
+    assert len(x) == 32 and len(y) == 32
+
+
+def test_missing_data_dir_raises():
+    import pytest
+    with pytest.raises(FileNotFoundError):
+        data_lib.load_or_synthesize("/definitely/not/here", "train")
+
+
+def test_iter_from_resumes_schedule():
+    x, y = data_lib.synthetic_mnist(64, seed=0)
+    b = data_lib.ShardedBatcher(x, y, 8, seed=5)
+    full = [bt["label"].tolist() for _, bt in zip(range(12), iter(b))]
+    resumed = [bt["label"].tolist() for _, bt in zip(range(7), b.iter_from(5))]
+    assert full[5:12] == resumed

@@ -1,0 +1,39 @@
+"""Structured JSONL metrics (the Promtail/Loki contract)."""
+import io
+import json
+
+from k8s_distributed_deeplearning_tpu.utils import metrics as m
+
+
+def test_jsonl_events_parse():
+    buf = io.StringIO()
+    log = m.MetricsLogger(stream=buf, job="t")
+    log.emit("start", world_size=8)
+    log.train_step(10, 0.5, 12.0, 800.0, 100.0, mfu=0.31, accuracy=0.9)
+    lines = [json.loads(l) for l in buf.getvalue().strip().splitlines()]
+    assert lines[0]["event"] == "start" and lines[0]["world_size"] == 8
+    step = lines[1]
+    assert step["event"] == "train_step" and step["step"] == 10
+    assert step["examples_per_sec_per_chip"] == 100.0
+    assert step["mfu"] == 0.31 and step["accuracy"] == 0.9
+
+
+def test_disabled_logger_emits_nothing():
+    buf = io.StringIO()
+    log = m.MetricsLogger(enabled=False, stream=buf)
+    log.emit("start")
+    assert buf.getvalue() == ""
+
+
+def test_file_sink(tmp_path):
+    p = tmp_path / "metrics.jsonl"
+    log = m.MetricsLogger(stream=io.StringIO(), path=str(p))
+    log.emit("checkpoint", step=3)
+    log.close()
+    rec = json.loads(p.read_text().strip())
+    assert rec["step"] == 3
+
+
+def test_mfu_math():
+    assert m.mfu(1e9, 100.0, 8, 197e12) == (1e9 * 100.0) / (197e12 * 8)
+    assert m.mfu(1e9, 100.0, 0, 197e12) == 0.0

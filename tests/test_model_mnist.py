@@ -1,0 +1,66 @@
+"""MNIST parity model: shapes, param structure, trainability."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from k8s_distributed_deeplearning_tpu.models import mnist
+
+
+def test_forward_shapes_and_flat_input():
+    model = mnist.MNISTConvNet()
+    params = model.init(jax.random.key(0), jnp.zeros((2, 28, 28, 1)))["params"]
+    logits = model.apply({"params": params}, jnp.zeros((2, 28, 28, 1)))
+    assert logits.shape == (2, 10)
+    # flat-784 input path (tensorflow_mnist.py:114 feeds flattened images)
+    logits2 = model.apply({"params": params}, jnp.zeros((3, 784)))
+    assert logits2.shape == (3, 10)
+
+
+def test_architecture_parity():
+    """conv5x5x32 -> conv5x5x64 -> dense1024 -> dense10 (tensorflow_mnist.py:49-67)."""
+    model = mnist.MNISTConvNet()
+    params = model.init(jax.random.key(0), jnp.zeros((1, 28, 28, 1)))["params"]
+    assert params["Conv_0"]["kernel"].shape == (5, 5, 1, 32)
+    assert params["Conv_1"]["kernel"].shape == (5, 5, 32, 64)
+    assert params["Dense_0"]["kernel"].shape == (7 * 7 * 64, 1024)
+    assert params["Dense_1"]["kernel"].shape == (1024, 10)
+
+
+def test_dropout_only_in_train_mode():
+    model = mnist.MNISTConvNet()
+    x = jnp.ones((4, 28, 28, 1))
+    params = model.init(jax.random.key(0), x)["params"]
+    e1 = model.apply({"params": params}, x, train=False)
+    e2 = model.apply({"params": params}, x, train=False)
+    np.testing.assert_allclose(e1, e2)
+    t1 = model.apply({"params": params}, x, train=True,
+                     rngs={"dropout": jax.random.key(1)})
+    t2 = model.apply({"params": params}, x, train=True,
+                     rngs={"dropout": jax.random.key(2)})
+    assert not np.allclose(t1, t2)
+
+
+def test_overfits_tiny_batch():
+    from k8s_distributed_deeplearning_tpu.train.data import synthetic_mnist
+    model = mnist.MNISTConvNet(dropout_rate=0.0)
+    x, y = synthetic_mnist(64, seed=0)
+    batch = {"image": x, "label": y}
+    params = model.init(jax.random.key(0), x[:1])["params"]
+    opt = optax.adam(1e-3)
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def step(params, opt_state, rng):
+        (loss, aux), grads = jax.value_and_grad(
+            lambda p: mnist.loss_fn(model, p, batch, rng), has_aux=True)(params)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss, aux
+
+    rng = jax.random.key(0)
+    acc = 0.0
+    for i in range(40):
+        rng, r = jax.random.split(rng)
+        params, opt_state, loss, aux = step(params, opt_state, r)
+        acc = float(aux["accuracy"])
+    assert acc > 0.9, f"failed to overfit: acc={acc}"

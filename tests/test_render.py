@@ -1,0 +1,71 @@
+"""Manifest renderer: deployment smoke without a cluster (SURVEY.md §4)."""
+import yaml
+
+from k8s_distributed_deeplearning_tpu.config import JobConfig
+from k8s_distributed_deeplearning_tpu.launch import render
+
+
+def _job(cfg):
+    return render.render_tpujob(cfg)
+
+
+def test_renders_three_docs_and_valid_yaml():
+    cfg = JobConfig(num_workers=4)
+    docs = render.render_all(cfg)
+    assert [d["kind"] for d in docs] == ["Namespace", "Service", "Job"]
+    parsed = list(yaml.safe_load_all(render.to_yaml(docs)))
+    assert parsed == docs
+
+
+def test_gang_scheduling_shape():
+    job = _job(JobConfig(num_workers=8, name="j", namespace="ns"))
+    spec = job["spec"]
+    assert spec["completions"] == 8 and spec["parallelism"] == 8
+    assert spec["completionMode"] == "Indexed"
+
+
+def test_coordinator_env_wiring():
+    job = _job(JobConfig(num_workers=2, name="mnist", namespace="ml-ops",
+                         coordinator_port=1234))
+    env = {e["name"]: e for e in
+           job["spec"]["template"]["spec"]["containers"][0]["env"]}
+    assert env["TPUJOB_COORDINATOR_ADDRESS"]["value"] == \
+        "mnist-0.mnist.ml-ops:1234"
+    assert env["TPUJOB_NUM_PROCESSES"]["value"] == "2"
+    # rank comes from the Job completion index annotation
+    assert "job-completion-index" in str(env["TPUJOB_PROCESS_ID"]["valueFrom"])
+
+
+def test_headless_service_matches_subdomain():
+    cfg = JobConfig(name="abc")
+    svc = render.render_service(cfg)
+    job = _job(cfg)
+    assert svc["spec"]["clusterIP"] == "None"
+    assert job["spec"]["template"]["spec"]["subdomain"] == svc["metadata"]["name"]
+
+
+def test_resources_and_tpu_selector():
+    job = _job(JobConfig(cpu="2", memory="4Gi", tpu_topology="2x4"))
+    tmpl = job["spec"]["template"]["spec"]
+    res = tmpl["containers"][0]["resources"]
+    # worker resources parity: tensorflow-mnist.yaml:49-53
+    assert res["requests"] == {"cpu": "2", "memory": "4Gi"}
+    assert "google.com/tpu" in res["limits"]
+    assert tmpl["nodeSelector"]["cloud.google.com/gke-tpu-topology"] == "2x4"
+
+
+def test_script_args_passthrough():
+    job = _job(JobConfig(script="examples/train_mnist.py",
+                         script_args=["--num-steps", "100"]))
+    cmd = job["spec"]["template"]["spec"]["containers"][0]["command"]
+    assert cmd == ["python", "examples/train_mnist.py", "--num-steps", "100"]
+
+
+def test_chips_per_worker_derived_from_topology():
+    # 2x4 slice (8 chips) over 2 workers -> 4 chips per pod; over 1 -> 8.
+    assert JobConfig(tpu_topology="2x4", num_workers=2).chips_per_worker() == 4
+    assert JobConfig(tpu_topology="2x4", num_workers=1).chips_per_worker() == 8
+    assert JobConfig(tpu_chips_per_worker=1).chips_per_worker() == 1
+    job = _job(JobConfig(tpu_topology="4x4", num_workers=4))
+    res = job["spec"]["template"]["spec"]["containers"][0]["resources"]
+    assert res["limits"]["google.com/tpu"] == "4"
