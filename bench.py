@@ -71,7 +71,15 @@ def main() -> None:
 
     if args.cpu_baseline:
         # Reference deployed config: per-rank batch 100 (tensorflow_mnist.py:160),
-        # fp32, CPU pod. Print raw images/sec for the parent to read.
+        # fp32, CPU pod. Env vars alone don't stick (the TPU boot hook re-pins
+        # JAX_PLATFORMS), so force the CPU backend the same way conftest does:
+        # deregister the TPU plugin factory before first device use.
+        import jax
+        import jax._src.xla_bridge as _xb
+        _xb._backend_factories.pop("axon", None)
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_platform_name", "cpu")
+        assert jax.devices()[0].platform == "cpu", jax.devices()
         ips = measure(batch_size=100, steps=10, warmup=2, dtype="float32")
         print(json.dumps({"cpu_images_per_sec": ips}))
         return

@@ -108,7 +108,12 @@ class JobConfig:
         chips = 1
         for d in self.tpu_topology.split("x"):
             chips *= int(d)
-        return max(1, chips // max(1, self.num_workers))
+        if self.num_workers <= 0 or chips % self.num_workers:
+            raise ValueError(
+                f"topology {self.tpu_topology} has {chips} chips, not evenly "
+                f"divisible across {self.num_workers} workers — GKE requires "
+                "each pod to claim all of its host's chips")
+        return chips // self.num_workers
 
 
 def add_train_flags(parser: argparse.ArgumentParser,

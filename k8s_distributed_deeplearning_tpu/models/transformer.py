@@ -1,0 +1,330 @@
+"""Decoder/encoder transformer core — shared by BERT, ViT, Llama, MoE.
+
+The reference has no transformer (its one model is the MNIST ConvNet,
+``horovod/tensorflow_mnist.py:38-73``); this module exists for the
+BASELINE.json scale-out configs and the long-context mandate. Design is
+TPU-first throughout:
+
+- every weight is created through :func:`flax.linen.with_logical_partitioning`
+  with **logical axis names** (``"embed"``, ``"mlp"``, ``"heads"`` …); the
+  mapping logical-axis → mesh-axis lives in one rule table
+  (:mod:`parallel.sharding`), so the same module runs pure-DP, FSDP,
+  Megatron-style TP, or any mix by swapping rules — no model edits;
+- activations carry :func:`flax.linen.with_logical_constraint` annotations at
+  layer boundaries so XLA's SPMD partitioner keeps them sharded instead of
+  round-tripping through replicated form;
+- compute dtype is bfloat16 by default (MXU-native), params stay f32;
+- the layer stack is a :func:`flax.linen.scan` (one compiled block body,
+  weights stacked on a leading ``"layers"`` axis) — compile time stays flat in
+  depth and the stacked layout is exactly what pipeline parallelism consumes;
+- optional :func:`flax.linen.remat` trades FLOPs for HBM (checkpointing every
+  block boundary), the standard long-context memory lever.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from k8s_distributed_deeplearning_tpu.ops import attention as attention_ops
+
+Dtype = Any
+default_init = nn.initializers.xavier_uniform
+embed_init = nn.initializers.normal(stddev=0.02)
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    """Architecture knobs shared by all transformer families."""
+
+    vocab_size: int = 32000
+    dim: int = 512
+    n_layers: int = 4
+    n_heads: int = 8
+    n_kv_heads: int | None = None       # < n_heads => GQA (Llama-3 style)
+    head_dim: int | None = None         # default dim // n_heads
+    mlp_dim: int | None = None          # default 4*dim (gelu) / per-family
+    max_seq_len: int = 2048
+    causal: bool = True
+    activation: str = "swiglu"          # "swiglu" | "gelu"
+    norm: str = "rmsnorm"               # "rmsnorm" | "layernorm"
+    position: str = "rope"              # "rope" | "learned" | "none"
+    rope_theta: float = 500000.0        # Llama-3 default
+    tie_embeddings: bool = False
+    dtype: Dtype = jnp.bfloat16         # compute dtype; params stay f32
+    attention_impl: str = "xla"         # "xla" | "flash" (pallas)
+    remat: bool = False                 # checkpoint each block
+    scan_layers: bool = True            # stack layers via nn.scan
+    dropout_rate: float = 0.0
+
+    @property
+    def resolved_head_dim(self) -> int:
+        return self.head_dim or self.dim // self.n_heads
+
+    @property
+    def resolved_kv_heads(self) -> int:
+        return self.n_kv_heads or self.n_heads
+
+    @property
+    def resolved_mlp_dim(self) -> int:
+        return self.mlp_dim or 4 * self.dim
+
+
+def param_dense(features, axes, name=None, dtype=jnp.bfloat16, use_bias=False):
+    """DenseGeneral whose kernel carries logical partitioning metadata."""
+    return nn.DenseGeneral(
+        features=features,
+        axis=-1,
+        use_bias=use_bias,
+        dtype=dtype,
+        param_dtype=jnp.float32,
+        kernel_init=nn.with_logical_partitioning(default_init(), axes),
+        bias_init=nn.with_logical_partitioning(nn.initializers.zeros, axes[1:]),
+        name=name,
+    )
+
+
+class RMSNorm(nn.Module):
+    """Root-mean-square layer norm (no mean subtraction, no bias) — the
+    Llama-family norm; variance accumulates in f32."""
+
+    eps: float = 1e-6
+    dtype: Dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        scale = self.param(
+            "scale", nn.with_logical_partitioning(nn.initializers.ones, ("embed",)),
+            (x.shape[-1],), jnp.float32)
+        var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+        y = x.astype(jnp.float32) * jax.lax.rsqrt(var + self.eps)
+        return (y * scale).astype(self.dtype)
+
+
+def make_norm(cfg: TransformerConfig, name: str):
+    if cfg.norm == "rmsnorm":
+        return RMSNorm(dtype=cfg.dtype, name=name)
+    return nn.LayerNorm(
+        dtype=cfg.dtype, param_dtype=jnp.float32, name=name,
+        scale_init=nn.with_logical_partitioning(nn.initializers.ones, ("embed",)),
+        bias_init=nn.with_logical_partitioning(nn.initializers.zeros, ("embed",)))
+
+
+def rope_frequencies(head_dim: int, max_seq_len: int,
+                     theta: float) -> tuple[jax.Array, jax.Array]:
+    """Precompute RoPE cos/sin tables, shape [max_seq_len, head_dim/2], f32."""
+    inv = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    t = jnp.arange(max_seq_len, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv)
+    return jnp.cos(freqs), jnp.sin(freqs)
+
+
+def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array,
+               positions: jax.Array | None = None) -> jax.Array:
+    """Rotate pairs (x[..., ::2], x[..., 1::2]) by position-dependent angles.
+
+    x: [B, S, H, D]; cos/sin: [max_seq, D/2]; positions: [B, S] or None
+    (None => 0..S-1). Rotation happens in f32 and casts back.
+    """
+    b, s, _, _ = x.shape
+    if positions is None:
+        cos_p, sin_p = cos[:s][None], sin[:s][None]          # [1, S, D/2]
+    else:
+        cos_p, sin_p = cos[positions], sin[positions]        # [B, S, D/2]
+    cos_p = cos_p[:, :, None, :]                             # [B|1, S, 1, D/2]
+    sin_p = sin_p[:, :, None, :]
+    xf = x.astype(jnp.float32)
+    x1, x2 = xf[..., ::2], xf[..., 1::2]
+    r1 = x1 * cos_p - x2 * sin_p
+    r2 = x2 * cos_p + x1 * sin_p
+    out = jnp.stack([r1, r2], axis=-1).reshape(x.shape)
+    return out.astype(x.dtype)
+
+
+class Attention(nn.Module):
+    """Multi-head / grouped-query attention with optional RoPE.
+
+    Logical sharding: Q/K/V kernels are [embed, heads|kv, head_dim] so a TP
+    rule mapping "heads"/"kv" to the tensor axis shards the heads dimension
+    (Megatron-style column parallel); the output projection is
+    [heads, head_dim, embed] (row parallel — XLA inserts the psum).
+    """
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array, *,
+                 mask: jax.Array | None = None,
+                 positions: jax.Array | None = None,
+                 attention_fn: Callable | None = None) -> jax.Array:
+        cfg = self.cfg
+        hd = cfg.resolved_head_dim
+        q = nn.DenseGeneral((cfg.n_heads, hd), axis=-1, use_bias=False,
+                            dtype=cfg.dtype, param_dtype=jnp.float32,
+                            kernel_init=nn.with_logical_partitioning(
+                                default_init(), ("embed", "heads", "head_dim")),
+                            name="q_proj")(x)
+        k = nn.DenseGeneral((cfg.resolved_kv_heads, hd), axis=-1, use_bias=False,
+                            dtype=cfg.dtype, param_dtype=jnp.float32,
+                            kernel_init=nn.with_logical_partitioning(
+                                default_init(), ("embed", "kv", "head_dim")),
+                            name="k_proj")(x)
+        v = nn.DenseGeneral((cfg.resolved_kv_heads, hd), axis=-1, use_bias=False,
+                            dtype=cfg.dtype, param_dtype=jnp.float32,
+                            kernel_init=nn.with_logical_partitioning(
+                                default_init(), ("embed", "kv", "head_dim")),
+                            name="v_proj")(x)
+        if cfg.position == "rope":
+            cos, sin = rope_frequencies(hd, cfg.max_seq_len, cfg.rope_theta)
+            q = apply_rope(q, cos, sin, positions)
+            k = apply_rope(k, cos, sin, positions)
+        q = nn.with_logical_constraint(q, ("batch", "seq", "heads", "head_dim"))
+        k = nn.with_logical_constraint(k, ("batch", "seq", "kv", "head_dim"))
+        v = nn.with_logical_constraint(v, ("batch", "seq", "kv", "head_dim"))
+
+        if attention_fn is not None:
+            out = attention_fn(q, k, v, causal=cfg.causal, mask=mask)
+        else:
+            out = attention_ops.multi_head_attention(
+                q, k, v, causal=cfg.causal, mask=mask, impl=cfg.attention_impl)
+        out = nn.with_logical_constraint(out, ("batch", "seq", "heads", "head_dim"))
+        out = nn.DenseGeneral(cfg.dim, axis=(-2, -1), use_bias=False,
+                              dtype=cfg.dtype, param_dtype=jnp.float32,
+                              kernel_init=nn.with_logical_partitioning(
+                                  default_init(), ("heads", "head_dim", "embed")),
+                              name="o_proj")(out)
+        return nn.with_logical_constraint(out, ("batch", "seq", "embed"))
+
+
+class MLP(nn.Module):
+    """Feed-forward: SwiGLU (Llama) or GELU (BERT/ViT). Column-parallel up
+    projections ("mlp" logical axis), row-parallel down projection."""
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        cfg = self.cfg
+        mlp = cfg.resolved_mlp_dim
+        if cfg.activation == "swiglu":
+            gate = param_dense(mlp, ("embed", "mlp"), "gate_proj", cfg.dtype)(x)
+            up = param_dense(mlp, ("embed", "mlp"), "up_proj", cfg.dtype)(x)
+            h = nn.silu(gate) * up
+        else:
+            h = param_dense(mlp, ("embed", "mlp"), "up_proj", cfg.dtype,
+                            use_bias=True)(x)
+            h = nn.gelu(h)
+        h = nn.with_logical_constraint(h, ("batch", "seq", "mlp"))
+        out = param_dense(cfg.dim, ("mlp", "embed"), "down_proj", cfg.dtype,
+                          use_bias=cfg.activation != "swiglu")(h)
+        return nn.with_logical_constraint(out, ("batch", "seq", "embed"))
+
+
+class Block(nn.Module):
+    """Pre-norm transformer block: x + attn(norm(x)); x + mlp(norm(x))."""
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array, *,
+                 mask: jax.Array | None = None,
+                 positions: jax.Array | None = None,
+                 deterministic: bool = True,
+                 attention_fn: Callable | None = None) -> jax.Array:
+        cfg = self.cfg
+        h = make_norm(cfg, "attn_norm")(x)
+        h = Attention(cfg, name="attn")(h, mask=mask, positions=positions,
+                                        attention_fn=attention_fn)
+        if cfg.dropout_rate:
+            h = nn.Dropout(cfg.dropout_rate, deterministic=deterministic)(h)
+        x = x + h
+        h = make_norm(cfg, "mlp_norm")(x)
+        h = MLP(cfg, name="mlp")(h)
+        if cfg.dropout_rate:
+            h = nn.Dropout(cfg.dropout_rate, deterministic=deterministic)(h)
+        x = x + h
+        return nn.with_logical_constraint(x, ("batch", "seq", "embed"))
+
+
+class Transformer(nn.Module):
+    """Token-in, hidden-states-out transformer stack.
+
+    ``nn.scan`` stacks the block weights on a leading "layers" axis (constant
+    compile time in depth; the layout pipeline parallelism slices); ``remat``
+    checkpoints each block for long-context memory. Both are config flags so
+    tests can exercise either path.
+    """
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, tokens_or_embeds: jax.Array, *,
+                 mask: jax.Array | None = None,
+                 positions: jax.Array | None = None,
+                 deterministic: bool = True,
+                 attention_fn: Callable | None = None) -> jax.Array:
+        cfg = self.cfg
+        if tokens_or_embeds.dtype in (jnp.int32, jnp.int64):
+            x = nn.Embed(cfg.vocab_size, cfg.dim, dtype=cfg.dtype,
+                         param_dtype=jnp.float32,
+                         embedding_init=nn.with_logical_partitioning(
+                             embed_init, ("vocab", "embed")),
+                         name="tok_embed")(tokens_or_embeds)
+        else:
+            x = tokens_or_embeds.astype(cfg.dtype)
+        if cfg.position == "learned":
+            pos = positions if positions is not None else jnp.arange(x.shape[1])
+            x = x + nn.Embed(cfg.max_seq_len, cfg.dim, dtype=cfg.dtype,
+                             param_dtype=jnp.float32,
+                             embedding_init=nn.with_logical_partitioning(
+                                 embed_init, (None, "embed")),
+                             name="pos_embed")(pos)
+        x = nn.with_logical_constraint(x, ("batch", "seq", "embed"))
+
+        block_cls = Block
+        if cfg.remat:
+            block_cls = nn.remat(
+                Block, prevent_cse=False,
+                static_argnums=(),
+                policy=jax.checkpoint_policies.nothing_saveable)
+        if cfg.scan_layers:
+            x, _ = nn.scan(
+                lambda mdl, carry, _: (
+                    mdl(carry, mask=mask, positions=positions,
+                        deterministic=deterministic,
+                        attention_fn=attention_fn), None),
+                variable_axes={"params": 0},
+                split_rngs={"params": True, "dropout": True},
+                length=cfg.n_layers,
+                metadata_params={nn.PARTITION_NAME: "layers"},
+            )(block_cls(cfg, name="blocks"), x, None)
+        else:
+            for i in range(cfg.n_layers):
+                x = block_cls(cfg, name=f"block_{i}")(
+                    x, mask=mask, positions=positions,
+                    deterministic=deterministic, attention_fn=attention_fn)
+        return make_norm(cfg, "final_norm")(x)
+
+
+class LMHead(nn.Module):
+    """Hidden states -> vocab logits; optionally tied to the input embedding."""
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array,
+                 embedding: jax.Array | None = None) -> jax.Array:
+        cfg = self.cfg
+        if cfg.tie_embeddings:
+            if embedding is None:
+                raise ValueError("tie_embeddings requires the embedding table")
+            logits = jnp.einsum("bsd,vd->bsv", x, embedding.astype(cfg.dtype),
+                                preferred_element_type=jnp.float32)
+        else:
+            logits = param_dense(cfg.vocab_size, ("embed", "vocab"),
+                                 "lm_head", cfg.dtype)(x)
+        # f32 logits for a numerically stable softmax-CE.
+        return logits.astype(jnp.float32)

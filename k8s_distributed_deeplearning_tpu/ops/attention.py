@@ -1,0 +1,84 @@
+"""Multi-head attention ops: reference XLA path + Pallas flash-attention path.
+
+The reference repo has no attention at all (SURVEY.md §2c — its only model is
+an MNIST ConvNet, ``horovod/tensorflow_mnist.py:38-73``); attention enters this
+framework through the BASELINE.json scale-out configs (BERT, ViT, Llama) and
+the long-context mandate. Two implementations share one signature:
+
+- ``impl="xla"``: einsum softmax attention — XLA fuses it well for short
+  sequences and it runs everywhere (CPU CI).
+- ``impl="flash"``: the Pallas TPU kernel in :mod:`ops.pallas_flash` — tiled
+  online-softmax so the S×S score matrix never materializes in HBM. Falls
+  back to interpret mode off-TPU so tests exercise the same code path.
+
+Layout is ``[batch, seq, heads, head_dim]`` (TPU-native: last dim 128-aligned
+head_dim rides the MXU lanes; batch*seq tiles the sublanes). GQA is supported
+by passing fewer KV heads than Q heads (num_q_heads % num_kv_heads == 0).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def _repeat_kv(k: jax.Array, num_q_heads: int) -> jax.Array:
+    """Expand KV heads to match Q heads for grouped-query attention."""
+    num_kv = k.shape[2]
+    if num_kv == num_q_heads:
+        return k
+    if num_q_heads % num_kv:
+        raise ValueError(f"{num_q_heads} q heads not divisible by {num_kv} kv heads")
+    return jnp.repeat(k, num_q_heads // num_kv, axis=2)
+
+
+def dot_product_attention(
+    q: jax.Array,  # [B, Sq, Hq, D]
+    k: jax.Array,  # [B, Sk, Hkv, D]
+    v: jax.Array,  # [B, Sk, Hkv, D]
+    *,
+    causal: bool = False,
+    mask: jax.Array | None = None,  # [B, 1|Hq, Sq, Sk] additive or bool
+    softmax_scale: float | None = None,
+) -> jax.Array:
+    """Reference einsum attention. Scores accumulate in f32 regardless of the
+    input dtype (bf16 QKV on the MXU, f32 softmax on the VPU)."""
+    *_, sq, hq, d = q.shape
+    sk = k.shape[1]
+    scale = softmax_scale if softmax_scale is not None else d ** -0.5
+    k = _repeat_kv(k, hq)
+    v = _repeat_kv(v, hq)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        row = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
+        col = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+        # Offset aligns the causal diagonal when Sq != Sk (decode steps).
+        scores = jnp.where(row + (sk - sq) >= col, scores, -jnp.inf)
+    if mask is not None:
+        if mask.dtype == jnp.bool_:
+            scores = jnp.where(mask, scores, -jnp.inf)
+        else:
+            scores = scores + mask
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v,
+                     preferred_element_type=jnp.float32)
+    return out.astype(q.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "softmax_scale", "impl"))
+def multi_head_attention(
+    q: jax.Array, k: jax.Array, v: jax.Array, *,
+    causal: bool = False,
+    mask: jax.Array | None = None,
+    softmax_scale: float | None = None,
+    impl: str = "xla",
+) -> jax.Array:
+    """Dispatch between the XLA reference and the Pallas flash kernel."""
+    if impl == "flash" and mask is None:
+        from k8s_distributed_deeplearning_tpu.ops import pallas_flash
+        return pallas_flash.flash_attention(
+            q, k, v, causal=causal, softmax_scale=softmax_scale)
+    return dot_product_attention(q, k, v, causal=causal, mask=mask,
+                                 softmax_scale=softmax_scale)

@@ -1,0 +1,176 @@
+"""Sequence/context parallelism: ring attention + Ulysses all-to-all.
+
+Long context is first-class in this framework (the reference has no sequence
+axis at all — its inputs are flat 784-vectors, ``tensorflow_mnist.py:114``;
+this subsystem implements the long-context mandate from SURVEY.md §5).
+
+Two standard schemes, both over a ``"sequence"`` mesh axis, both written as
+SPMD collectives to be called **inside** ``shard_map`` (or wrapped via
+:func:`make_context_parallel_attention` for the jit-based trainer):
+
+- **Ring attention** (Liu et al., blockwise): Q stays put; K/V shards rotate
+  around the ring via ``lax.ppermute`` while each device accumulates its
+  queries' attention with an online softmax (running max ``m``, normalizer
+  ``l``, unnormalized accumulator ``o`` — flash-attention statistics). Peak
+  memory per device is O(S_local²) scores, never the global S² matrix, and
+  the N-1 rotations ride ICI neighbor links. The rotation schedule unrolls at
+  trace time so XLA overlaps each ppermute with the previous block's compute.
+- **Ulysses** (all-to-all): transpose seq-sharding into head-sharding with
+  ``lax.all_to_all``, run ordinary (local, e.g. flash) attention over the full
+  sequence per head group, transpose back. Cheaper at moderate S (two
+  all-to-alls instead of N-1 rotations) but caps sequence parallelism at the
+  head count.
+
+Causality across shards: device r owns global query positions
+[r·S_local, (r+1)·S_local). At ring step t it holds KV from source rank
+(r + t) mod N: earlier ranks attend fully, the diagonal block causally, later
+ranks contribute nothing (masked; the lanes still run — SPMD).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh
+from jax.sharding import PartitionSpec as P
+
+NEG_INF = -1e30  # large-but-finite: avoids inf-inf NaNs in online softmax
+
+
+def _block_attend(q, k, v, mask, softmax_scale):
+    """One blockwise attention step -> (block_out, block_rowsum, block_rowmax).
+
+    q: [B,Sq,H,D]; k/v: [B,Sk,H,D]; mask: [Sq,Sk] bool or None.
+    Returns f32 (o_block unnormalized, l row-sums, m row-maxes) per flash
+    attention: softmax deferred until all blocks are merged.
+    """
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * softmax_scale
+    if mask is not None:
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    m = jnp.max(s, axis=-1)                        # [B,H,Sq]
+    p = jnp.exp(s - m[..., None])
+    p = jnp.where(s <= NEG_INF / 2, 0.0, p)        # fully-masked rows -> 0
+    l = jnp.sum(p, axis=-1)                        # [B,H,Sq]
+    o = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+                   preferred_element_type=jnp.float32)
+    return o.astype(jnp.float32), l, m
+
+
+def _repeat_kv(x, n_rep):
+    return x if n_rep == 1 else jnp.repeat(x, n_rep, axis=2)
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                   axis_name: str = "sequence", causal: bool = True,
+                   softmax_scale: float | None = None) -> jax.Array:
+    """Exact attention over a sequence-sharded QKV, inside ``shard_map``.
+
+    q/k/v: this device's sequence shard, [B, S_local, H(q|kv), D]. Output has
+    q's shape. Matches single-device attention bit-for-bit up to f32 softmax
+    reassociation (verified in tests against ``ops.attention``).
+    """
+    n = lax.axis_size(axis_name)
+    r = lax.axis_index(axis_name)
+    hq, hkv = q.shape[2], k.shape[2]
+    k = _repeat_kv(k, hq // hkv)
+    v = _repeat_kv(v, hq // hkv)
+    scale = softmax_scale if softmax_scale is not None else q.shape[-1] ** -0.5
+    sq, sk = q.shape[1], k.shape[1]
+    b, h = q.shape[0], q.shape[2]
+
+    o = jnp.zeros((b, sq, h, q.shape[-1]), jnp.float32)
+    l = jnp.zeros((b, h, sq), jnp.float32)
+    m = jnp.full((b, h, sq), NEG_INF, jnp.float32)
+
+    row = lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
+    col = lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+    shift_perm = [(i, (i - 1) % n) for i in range(n)]
+
+    for t in range(n):
+        # Rotation sends shard i to i-1, so at step t we hold rank (r+t)%n's KV.
+        src = (r + t) % n
+        if causal:
+            # Global positions: queries r*sq + row, keys src*sk + col.
+            mask = (r * sq + row) >= (src * sk + col)
+        else:
+            mask = None
+        bo, bl, bm = _block_attend(q, k, v, mask, scale)
+        m_new = jnp.maximum(m, bm)
+        alpha = jnp.exp(m - m_new)        # rescale old accumulator
+        beta = jnp.exp(bm - m_new)        # rescale incoming block
+        l = alpha * l + beta * bl
+        o = (alpha.transpose(0, 2, 1)[..., None] * o
+             + beta.transpose(0, 2, 1)[..., None] * bo)
+        m = m_new
+        if t != n - 1:  # rotate KV to the next ring position
+            k = lax.ppermute(k, axis_name, shift_perm)
+            v = lax.ppermute(v, axis_name, shift_perm)
+
+    norm = jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
+    return (o / norm).astype(q.dtype)
+
+
+def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                      axis_name: str = "sequence", causal: bool = True,
+                      softmax_scale: float | None = None,
+                      inner: Callable | None = None) -> jax.Array:
+    """All-to-all sequence parallelism (DeepSpeed-Ulysses scheme), inside
+    ``shard_map``: redistribute [B, S/N, H, D] -> [B, S, H/N, D], attend over
+    the full sequence locally, redistribute back. Requires H % N == 0.
+    """
+    n = lax.axis_size(axis_name)
+    hq, hkv = q.shape[2], k.shape[2]
+    if hkv != hq:  # GQA: expand before the head split so H/N stays integral
+        q_rep = 1
+        k = _repeat_kv(k, hq // hkv)
+        v = _repeat_kv(v, hq // hkv)
+    if hq % n:
+        raise ValueError(f"ulysses needs heads {hq} divisible by axis size {n}")
+
+    def seq_to_heads(x):  # [B, S/N, H, D] -> [B, S, H/N, D]
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    def heads_to_seq(x):  # [B, S, H/N, D] -> [B, S/N, H, D]
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    qg, kg, vg = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    if inner is None:
+        from k8s_distributed_deeplearning_tpu.ops.attention import (
+            dot_product_attention)
+        inner = functools.partial(dot_product_attention)
+    out = inner(qg, kg, vg, causal=causal, softmax_scale=softmax_scale)
+    return heads_to_seq(out)
+
+
+def make_context_parallel_attention(
+        mesh: Mesh, impl: str = "ring", axis_name: str = "sequence",
+        batch_axes=("data", "fsdp")) -> Callable:
+    """Wrap ring/Ulysses attention as an ``attention_fn`` for the transformer
+    core under the jit-based :class:`~parallel.sharding.ShardedTrainer`.
+
+    The returned fn takes *global* [B,S,H,D] arrays (jit view); shard_map
+    splits batch over the data axes and sequence over ``axis_name``, runs the
+    SPMD kernel, and hands jit back a seq-sharded global output.
+    """
+    fn = {"ring": ring_attention, "ulysses": ulysses_attention}[impl]
+    batch = tuple(a for a in batch_axes if a in mesh.axis_names)
+    spec = P(batch or None, axis_name, None, None)
+
+    def attention_fn(q, k, v, *, causal=True, mask=None, softmax_scale=None):
+        if mask is not None:
+            raise NotImplementedError(
+                "context-parallel attention supports causal masking only")
+        sharded = jax.shard_map(
+            functools.partial(fn, axis_name=axis_name, causal=causal,
+                              softmax_scale=softmax_scale),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=False)
+        return sharded(q, k, v)
+
+    return attention_fn

@@ -48,6 +48,10 @@ class Reduction(enum.Enum):
 def reduce_gradients(grads: PyTree, axis_name: str, axis_size: int,
                      reduction: Reduction,
                      bucket_bytes: int | None = None) -> PyTree:
+    if bucket_bytes and reduction is not Reduction.AVERAGE:
+        raise ValueError(
+            f"bucket_bytes is only supported with Reduction.AVERAGE, "
+            f"got {reduction}")
     if reduction is Reduction.AVERAGE:
         if bucket_bytes:
             from k8s_distributed_deeplearning_tpu.runtime.fusion import FusionPlanner

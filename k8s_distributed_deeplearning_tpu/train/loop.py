@@ -61,6 +61,7 @@ def fit(
     batch_iter = batches(start_step) if callable(batches) else batches
     n_dev = jax.device_count()
     t_last = time.monotonic()
+    step_last = start_step  # steps actually in the current timing window
     step = start_step
     for step in range(start_step, num_steps):
         batch = next(batch_iter)
@@ -70,8 +71,9 @@ def fit(
         if metrics and log_every and (step + 1) % log_every == 0:
             loss_f = float(loss)  # blocks: this is the host sync point
             now = time.monotonic()
-            dt_ms = (now - t_last) * 1e3 / log_every
+            dt_ms = (now - t_last) * 1e3 / (step + 1 - step_last)
             t_last = now
+            step_last = step + 1
             eps = (global_batch_size or 0) / (dt_ms / 1e3) if global_batch_size else 0.0
             extra = {}
             for k, v in (aux or {}).items():

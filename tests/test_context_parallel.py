@@ -1,0 +1,106 @@
+"""Ring attention + Ulysses must match single-device attention exactly."""
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from k8s_distributed_deeplearning_tpu.models import llama
+from k8s_distributed_deeplearning_tpu.ops import attention as attn_ops
+from k8s_distributed_deeplearning_tpu.parallel import context_parallel as cp
+from k8s_distributed_deeplearning_tpu.parallel import mesh as mesh_lib
+from k8s_distributed_deeplearning_tpu.parallel import sharding
+
+
+def _qkv(b=2, s=32, hq=4, hkv=4, d=8, seed=0):
+    ks = jax.random.split(jax.random.key(seed), 3)
+    return (jax.random.normal(ks[0], (b, s, hq, d)),
+            jax.random.normal(ks[1], (b, s, hkv, d)),
+            jax.random.normal(ks[2], (b, s, hkv, d)))
+
+
+def _run_sharded(fn, q, k, v, n=8, **kw):
+    mesh = mesh_lib.make_mesh({"sequence": n})
+    spec = P(None, "sequence", None, None)
+    wrapped = jax.shard_map(functools.partial(fn, **kw), mesh=mesh,
+                            in_specs=(spec, spec, spec), out_specs=spec,
+                            check_vma=False)
+    return jax.jit(wrapped)(q, k, v)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_attention_matches_reference(causal):
+    q, k, v = _qkv()
+    ref = attn_ops.dot_product_attention(q, k, v, causal=causal)
+    out = _run_sharded(cp.ring_attention, q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_ring_attention_gqa():
+    q, k, v = _qkv(hq=4, hkv=2)
+    ref = attn_ops.dot_product_attention(q, k, v, causal=True)
+    out = _run_sharded(cp.ring_attention, q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ulysses_matches_reference(causal):
+    q, k, v = _qkv(hq=8)
+    ref = attn_ops.dot_product_attention(q, k, v, causal=causal)
+    out = _run_sharded(cp.ulysses_attention, q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_ring_attention_grads_match():
+    q, k, v = _qkv(s=16)
+
+    def loss_ref(q, k, v):
+        return attn_ops.dot_product_attention(q, k, v, causal=True).sum()
+
+    def loss_ring(q, k, v):
+        return _run_sharded(cp.ring_attention, q, k, v, causal=True).sum()
+
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    g_ring = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_ref, g_ring):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a), atol=3e-5)
+
+
+def test_llama_trains_with_ring_attention():
+    """End-to-end: tiny Llama on a data×sequence mesh, ring attention inside
+    the jit-based trainer, loss decreases and matches the plain-attention
+    trainer numerically."""
+    cfg = llama.config_tiny(dtype=jnp.float32, n_heads=4, n_kv_heads=4)
+    model = llama.LlamaLM(cfg)
+    tokens = jax.random.randint(jax.random.key(7), (8, 33), 0, cfg.vocab_size)
+
+    def losses_on(mesh, attention_fn=None):
+        def loss(params, batch, rng):
+            toks = batch["tokens"]
+            inputs, targets = toks[:, :-1], toks[:, 1:]
+            logits = model.apply({"params": params}, inputs,
+                                 attention_fn=attention_fn)
+            return (optax.softmax_cross_entropy_with_integer_labels(
+                logits, targets).mean(), {})
+
+        tr = sharding.ShardedTrainer(loss, optax.adam(1e-3), mesh)
+        state = tr.init(
+            lambda r: model.init(r, jnp.zeros((1, 8), jnp.int32))["params"],
+            jax.random.key(0))
+        step = tr.make_step(donate=False)
+        batch = tr.shard_batch({"tokens": tokens})
+        out = []
+        for i in range(3):
+            state, l, _ = step(state, batch, jax.random.key(i))
+            out.append(float(l))
+        return out
+
+    mesh_cp = mesh_lib.make_mesh({"data": 2, "sequence": 4})
+    ring_fn = cp.make_context_parallel_attention(mesh_cp, "ring")
+    got = losses_on(mesh_cp, ring_fn)
+    ref = losses_on(mesh_lib.make_mesh({"data": 8}))
+    assert got[-1] < got[0]
+    np.testing.assert_allclose(got, ref, rtol=2e-4)

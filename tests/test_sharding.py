@@ -1,0 +1,97 @@
+"""ShardedTrainer: DP / FSDP / TP / mixed meshes must all train identically.
+
+The decisive property: the *same* model + rule table, trained on meshes with
+different parallelism axes, produces the same losses — communication layout
+changes, math doesn't. This is the test the reference could never write (its
+one strategy was Horovod DP); it validates SURVEY.md §2c's build implication.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from k8s_distributed_deeplearning_tpu.models import llama
+from k8s_distributed_deeplearning_tpu.parallel import mesh as mesh_lib
+from k8s_distributed_deeplearning_tpu.parallel import sharding
+
+
+def _make_trainer(mesh):
+    cfg = llama.config_tiny(dtype=jnp.float32, dim=64, n_layers=2)
+    model = llama.LlamaLM(cfg)
+
+    def loss(params, batch, rng):
+        return llama.loss_fn(model, params, batch, rng)
+
+    trainer = sharding.ShardedTrainer(loss, optax.adam(1e-3), mesh)
+    init_fn = lambda r: model.init(r, jnp.zeros((1, 16), jnp.int32))["params"]
+    state = trainer.init(init_fn, jax.random.key(0))
+    step = trainer.make_step(donate=False)
+    return trainer, state, step
+
+
+def _run_steps(mesh, n=3):
+    trainer, state, step = _make_trainer(mesh)
+    tokens = jax.random.randint(jax.random.key(42), (8, 17), 0, 256)
+    batch = trainer.shard_batch({"tokens": tokens})
+    losses = []
+    for i in range(n):
+        state, loss, aux = step(state, batch, jax.random.key(i))
+        losses.append(float(loss))
+    return losses, state
+
+
+MESHES = {
+    "dp8": {"data": 8},
+    "fsdp8": {"fsdp": 8},
+    "dp2_fsdp4": {"data": 2, "fsdp": 4},
+    "tp8": {"tensor": 8},
+    "dp2_tp4": {"data": 2, "tensor": 4},
+    "dp2_fsdp2_tp2": {"data": 2, "fsdp": 2, "tensor": 2},
+}
+
+
+@pytest.mark.parametrize("name", list(MESHES))
+def test_training_runs_on_mesh(name):
+    losses, _ = _run_steps(mesh_lib.make_mesh(MESHES[name]), n=3)
+    assert all(np.isfinite(l) for l in losses)
+    assert losses[-1] < losses[0], f"loss not decreasing on {name}: {losses}"
+
+
+def test_meshes_agree_numerically():
+    ref, _ = _run_steps(mesh_lib.make_mesh({"data": 8}), n=2)
+    for spec in ({"fsdp": 8}, {"dp": 2, "tensor": 4} and {"tensor": 8},
+                 {"data": 2, "fsdp": 2, "tensor": 2}):
+        got, _ = _run_steps(mesh_lib.make_mesh(spec), n=2)
+        np.testing.assert_allclose(got, ref, rtol=2e-4), spec
+
+
+def test_fsdp_actually_shards_params():
+    mesh = mesh_lib.make_mesh({"fsdp": 8})
+    trainer, state, _ = _make_trainer(mesh)
+    # At least the big embedding/MLP kernels must be split across devices.
+    leaves = jax.tree.leaves(sharding.unbox(state.params))
+    sharded = [l for l in leaves
+               if l.size >= 8 and not l.sharding.is_fully_replicated]
+    assert sharded, "no parameter is sharded under the fsdp rules"
+    # A sharded leaf's per-device shard must be smaller than the array.
+    big = max(sharded, key=lambda l: l.size)
+    shard_sizes = {s.data.size for s in big.addressable_shards}
+    assert max(shard_sizes) < big.size
+
+
+def test_tp_shards_heads_and_mlp():
+    mesh = mesh_lib.make_mesh({"tensor": 8})
+    trainer, state, _ = _make_trainer(mesh)
+    import flax
+    flat = flax.traverse_util.flatten_dict(
+        sharding.unbox(state.params), sep="/")
+    mlp_kernel = next(v for k, v in flat.items() if "gate_proj" in k)
+    assert not mlp_kernel.sharding.is_fully_replicated
+
+
+def test_resolve_rules_filters_absent_axes():
+    mesh = mesh_lib.make_mesh({"data": 8})
+    rules = dict(sharding.resolve_rules(mesh))
+    assert rules["mlp"] is None          # no tensor axis in this mesh
+    assert rules["batch"] == ("data",)   # fsdp filtered out of the tuple
