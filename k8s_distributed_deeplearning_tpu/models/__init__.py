@@ -1,3 +1,13 @@
-"""Model zoo."""
+"""Model zoo: MNIST ConvNet (reference parity) + the BASELINE.json scale-out
+families (ResNet, BERT, ViT, Llama, MoE) on the shared transformer core."""
 
 from k8s_distributed_deeplearning_tpu.models.mnist import MNISTConvNet  # noqa: F401
+from k8s_distributed_deeplearning_tpu.models.transformer import (  # noqa: F401
+    Transformer,
+    TransformerConfig,
+)
+from k8s_distributed_deeplearning_tpu.models.llama import LlamaLM  # noqa: F401
+from k8s_distributed_deeplearning_tpu.models.bert import BertMLM  # noqa: F401
+from k8s_distributed_deeplearning_tpu.models.vit import ViT  # noqa: F401
+from k8s_distributed_deeplearning_tpu.models.resnet import ResNet  # noqa: F401
+from k8s_distributed_deeplearning_tpu.models.moe import MoELM, MoEConfig  # noqa: F401

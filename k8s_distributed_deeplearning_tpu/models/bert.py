@@ -1,0 +1,90 @@
+"""BERT-family masked-LM encoder — BASELINE.json config #3.
+
+Built on the shared transformer core (``models/transformer.py``) with the
+BERT recipe: bidirectional attention, learned positions, LayerNorm, GELU MLP,
+tied MLM output head. Exercises the large-gradient allreduce path the config
+list names (~110M params of mostly-dense gradients every step).
+"""
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import optax
+
+from k8s_distributed_deeplearning_tpu.models.transformer import (
+    Transformer, TransformerConfig, embed_init)
+
+
+def config_bert_base(**overrides) -> TransformerConfig:
+    base = dict(vocab_size=30522, dim=768, n_layers=12, n_heads=12,
+                mlp_dim=3072, max_seq_len=512, causal=False,
+                activation="gelu", norm="layernorm", position="learned",
+                tie_embeddings=True)
+    base.update(overrides)
+    return TransformerConfig(**base)
+
+
+def config_tiny(**overrides) -> TransformerConfig:
+    base = dict(vocab_size=256, dim=64, n_layers=2, n_heads=4, mlp_dim=128,
+                max_seq_len=64, causal=False, activation="gelu",
+                norm="layernorm", position="learned", tie_embeddings=True)
+    base.update(overrides)
+    return TransformerConfig(**base)
+
+
+class BertMLM(nn.Module):
+    """Encoder + tied MLM head (transform dense + layernorm per BERT)."""
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, tokens: jax.Array, *,
+                 deterministic: bool = True) -> jax.Array:
+        cfg = self.cfg
+        x = Transformer(cfg, name="encoder")(tokens,
+                                             deterministic=deterministic)
+        # MLM transform head (dense + gelu + LN), then tied decode.
+        x = nn.Dense(cfg.dim, dtype=cfg.dtype, param_dtype=jnp.float32,
+                     kernel_init=nn.with_logical_partitioning(
+                         nn.initializers.xavier_uniform(),
+                         ("embed", "embed_out")),
+                     name="mlm_dense")(x)
+        x = nn.gelu(x)
+        x = nn.LayerNorm(dtype=cfg.dtype, param_dtype=jnp.float32,
+                         name="mlm_norm")(x)
+        embedding = self.variables["params"]["encoder"]["tok_embed"]["embedding"]
+        embedding = nn.meta.unbox(embedding)
+        logits = jnp.einsum("bsd,vd->bsv", x, embedding.astype(cfg.dtype),
+                            preferred_element_type=jnp.float32)
+        bias = self.param("mlm_bias", nn.initializers.zeros,
+                          (cfg.vocab_size,), jnp.float32)
+        return logits.astype(jnp.float32) + bias
+
+
+def mask_tokens(tokens: jax.Array, rng: jax.Array, *, vocab_size: int,
+                mask_id: int, mask_prob: float = 0.15):
+    """Standard BERT masking: select 15%, of those 80% -> [MASK], 10% random,
+    10% unchanged. Returns (masked_inputs, targets, weights)."""
+    r1, r2, r3 = jax.random.split(rng, 3)
+    selected = jax.random.uniform(r1, tokens.shape) < mask_prob
+    action = jax.random.uniform(r2, tokens.shape)
+    random_tok = jax.random.randint(r3, tokens.shape, 0, vocab_size)
+    inputs = jnp.where(selected & (action < 0.8), mask_id, tokens)
+    inputs = jnp.where(selected & (action >= 0.8) & (action < 0.9),
+                       random_tok, inputs)
+    return inputs, tokens, selected.astype(jnp.float32)
+
+
+def loss_fn(model: BertMLM, params, batch, rng=None):
+    """MLM loss over masked positions. ``batch``: {"inputs", "targets",
+    "weights"} (from :func:`mask_tokens`)."""
+    logits = model.apply({"params": params}, batch["inputs"],
+                         deterministic=True)
+    ce = optax.softmax_cross_entropy_with_integer_labels(
+        logits, batch["targets"])
+    w = batch["weights"]
+    loss = (ce * w).sum() / jnp.maximum(w.sum(), 1.0)
+    acc = (((logits.argmax(-1) == batch["targets"]) * w).sum()
+           / jnp.maximum(w.sum(), 1.0))
+    return loss, {"accuracy": acc}

@@ -1,0 +1,209 @@
+"""Mixture-of-Experts layers + expert parallelism.
+
+Absent from the reference (SURVEY.md §2c lists EP as a gap to fill); built
+TPU-first: routing is the dense one-hot dispatch/combine formulation (Switch
+Transformer style) — every tensor is static-shaped, the dispatch and combine
+are einsums that tile onto the MXU, and there is no scatter/gather or
+data-dependent shape anywhere, so XLA can compile and overlap the all-to-all
+the sharding induces.
+
+Expert parallelism falls out of the logical-axis system: expert weights carry
+the "expert" logical axis -> the rule table maps it to the "expert" mesh axis
+-> dispatching tokens (sharded over "data") into expert buffers (sharded over
+"expert") makes XLA emit the all-to-all, exactly where a hand-written MoE
+framework would place NCCL alltoall calls.
+
+Router details: top-k gating with renormalized probabilities, position-in-
+expert by cumulative sum (earlier tokens win capacity), overflow tokens pass
+through the residual unchanged (standard drop policy), Switch load-balance
+aux loss + router z-loss exposed via ``sow("intermediates", ...)``.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import optax
+
+from k8s_distributed_deeplearning_tpu.models.transformer import (
+    Attention, TransformerConfig, default_init, embed_init, make_norm)
+
+Dtype = Any
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    """MoE knobs layered on top of a TransformerConfig."""
+
+    num_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    aux_loss_weight: float = 0.01
+    router_z_weight: float = 1e-3
+
+
+def top_k_routing(logits: jax.Array, k: int, capacity: int):
+    """Static-shape top-k routing.
+
+    logits: [T, E] router scores. Returns (dispatch [T, E, C] bool,
+    combine [T, E, C] f32, aux_metrics dict). Token t's c-th capacity slot in
+    expert e is set when t routed there and fewer than C earlier tokens did.
+    """
+    t, e = logits.shape
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+
+    remaining = probs
+    assign = []     # k one-hot [T, E] masks
+    gates = []      # k [T] gate values
+    for _ in range(k):
+        idx = jnp.argmax(remaining, axis=-1)
+        one_hot = jax.nn.one_hot(idx, e, dtype=jnp.float32)
+        assign.append(one_hot)
+        gates.append(jnp.sum(probs * one_hot, axis=-1))
+        remaining = remaining * (1.0 - one_hot)
+
+    # Renormalize the k gates per token.
+    gate_stack = jnp.stack(gates, axis=0)                     # [k, T]
+    gate_stack = gate_stack / jnp.maximum(
+        jnp.sum(gate_stack, axis=0, keepdims=True), 1e-9)
+
+    dispatch = jnp.zeros((t, e, capacity), jnp.bool_)
+    combine = jnp.zeros((t, e, capacity), jnp.float32)
+    # Choice 0 for all tokens takes capacity priority over choice 1, then
+    # token order breaks ties (cumsum over T).
+    used = jnp.zeros((e,), jnp.float32)                       # slots taken so far
+    for c in range(k):
+        one_hot = assign[c]                                   # [T, E]
+        pos = jnp.cumsum(one_hot, axis=0) - one_hot + used    # [T, E] slot index
+        keep = one_hot * (pos < capacity)
+        slot = jax.nn.one_hot(pos.astype(jnp.int32), capacity,
+                              dtype=jnp.float32)              # [T, E, C]
+        sel = keep[..., None] * slot
+        dispatch = dispatch | (sel > 0)
+        combine = combine + gate_stack[c][:, None, None] * sel
+        used = used + jnp.sum(keep, axis=0)
+
+    # Switch load-balance loss: E * Σ_e fraction_tokens_e · mean_prob_e.
+    f = jnp.mean(assign[0], axis=0)
+    p = jnp.mean(probs, axis=0)
+    aux = {
+        "load_balance_loss": e * jnp.sum(f * p),
+        "router_z_loss": jnp.mean(
+            jnp.square(jax.nn.logsumexp(logits.astype(jnp.float32), axis=-1))),
+        "fraction_dropped": 1.0 - jnp.sum(combine > 0) / (t * k),
+    }
+    return dispatch, combine, aux
+
+
+class MoEMLP(nn.Module):
+    """Expert-parallel SwiGLU MLP with top-k routing.
+
+    Expert weights are [E, ...] with the "expert" logical axis; dispatch and
+    combine einsums bridge token-sharding to expert-sharding (XLA inserts the
+    all-to-all when the mesh has an expert axis).
+    """
+
+    cfg: TransformerConfig
+    moe: MoEConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        cfg, moe = self.cfg, self.moe
+        b, s, d = x.shape
+        mlp = cfg.resolved_mlp_dim
+        e = moe.num_experts
+        tokens = x.reshape(b * s, d)
+        t = b * s
+        capacity = max(1, int(moe.capacity_factor * moe.top_k * t / e))
+
+        router_w = self.param(
+            "router", nn.with_logical_partitioning(default_init(),
+                                                   ("embed", "expert")),
+            (d, e), jnp.float32)
+        logits = tokens.astype(jnp.float32) @ router_w
+        dispatch, combine, aux = top_k_routing(logits, moe.top_k, capacity)
+        for name, val in aux.items():
+            self.sow("intermediates", name, val)
+
+        def expert_param(name, shape, axes):
+            return self.param(
+                name, nn.with_logical_partitioning(default_init(), axes),
+                shape, jnp.float32).astype(cfg.dtype)
+
+        w_gate = expert_param("w_gate", (e, d, mlp), ("expert", "embed", "mlp"))
+        w_up = expert_param("w_up", (e, d, mlp), ("expert", "embed", "mlp"))
+        w_down = expert_param("w_down", (e, mlp, d), ("expert", "mlp", "embed"))
+
+        # Dispatch: [T,d] tokens -> [E,C,d] expert buffers (the all-to-all).
+        xe = jnp.einsum("tec,td->ecd", dispatch.astype(cfg.dtype),
+                        tokens.astype(cfg.dtype))
+        xe = nn.with_logical_constraint(xe, ("expert", None, "embed"))
+        h = jnp.einsum("ecd,edm->ecm", xe, w_gate)
+        h = nn.silu(h) * jnp.einsum("ecd,edm->ecm", xe, w_up)
+        h = nn.with_logical_constraint(h, ("expert", None, "mlp"))
+        ye = jnp.einsum("ecm,emd->ecd", h, w_down)
+        ye = nn.with_logical_constraint(ye, ("expert", None, "embed"))
+        # Combine back to token order, weighted by the gates.
+        y = jnp.einsum("tec,ecd->td", combine.astype(cfg.dtype), ye)
+        return y.reshape(b, s, d)
+
+
+class MoEBlock(nn.Module):
+    """Pre-norm block with MoE feed-forward."""
+
+    cfg: TransformerConfig
+    moe: MoEConfig
+
+    @nn.compact
+    def __call__(self, x, *, positions=None, attention_fn=None):
+        cfg = self.cfg
+        h = make_norm(cfg, "attn_norm")(x)
+        h = Attention(cfg, name="attn")(h, positions=positions,
+                                        attention_fn=attention_fn)
+        x = x + h
+        h = make_norm(cfg, "mlp_norm")(x)
+        h = MoEMLP(cfg, self.moe, name="moe")(h)
+        x = x + h
+        return nn.with_logical_constraint(x, ("batch", "seq", "embed"))
+
+
+class MoELM(nn.Module):
+    """Decoder-only MoE language model (every layer MoE, GShard-dense layout)."""
+
+    cfg: TransformerConfig
+    moe: MoEConfig
+
+    @nn.compact
+    def __call__(self, tokens, *, positions=None, attention_fn=None):
+        cfg = self.cfg
+        x = nn.Embed(cfg.vocab_size, cfg.dim, dtype=cfg.dtype,
+                     param_dtype=jnp.float32,
+                     embedding_init=nn.with_logical_partitioning(
+                         embed_init, ("vocab", "embed")),
+                     name="tok_embed")(tokens)
+        for i in range(cfg.n_layers):
+            x = MoEBlock(cfg, self.moe, name=f"block_{i}")(
+                x, positions=positions, attention_fn=attention_fn)
+        x = make_norm(cfg, "final_norm")(x)
+        from k8s_distributed_deeplearning_tpu.models.transformer import LMHead
+        return LMHead(cfg, name="head")(x)
+
+
+def loss_fn(model: MoELM, moe: MoEConfig, params, batch, rng=None):
+    """Next-token CE + load-balance and router-z auxiliary losses."""
+    tokens = batch["tokens"]
+    inputs, targets = tokens[:, :-1], tokens[:, 1:]
+    logits, state = model.apply({"params": params}, inputs,
+                                mutable=["intermediates"])
+    ce = optax.softmax_cross_entropy_with_integer_labels(logits, targets).mean()
+    flat = jax.tree_util.tree_flatten_with_path(state["intermediates"])[0]
+    lb = [v for path, v in flat if "load_balance_loss" in str(path)]
+    zs = [v for path, v in flat if "router_z_loss" in str(path)]
+    aux_loss = (moe.aux_loss_weight * sum(jnp.mean(l) for l in lb)
+                + moe.router_z_weight * sum(jnp.mean(z) for z in zs))
+    loss = ce + aux_loss
+    acc = (logits.argmax(-1) == targets).mean()
+    return loss, {"ce": ce, "aux_loss": aux_loss, "accuracy": acc}

@@ -1,0 +1,112 @@
+"""ResNet-v1.5 family (ResNet-50 flagship) — BASELINE.json config #2.
+
+The reference's only vision model is the MNIST ConvNet
+(``horovod/tensorflow_mnist.py:38-73``); ResNet-50/ImageNet DP is the first
+scale-out config. TPU-first choices: NHWC layout (channels ride the 128-lane
+dim), bfloat16 compute with f32 batch-norm statistics, and the v1.5 stride
+placement (stride in the 3×3, not the 1×1 — the variant every modern
+benchmark uses).
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import optax
+
+Dtype = Any
+
+
+class BottleneckBlock(nn.Module):
+    filters: int
+    stride: int = 1
+    dtype: Dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, *, train: bool):
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype,
+                       param_dtype=jnp.float32)
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, epsilon=1e-5, dtype=self.dtype,
+                       param_dtype=jnp.float32)
+        residual = x
+        y = conv(self.filters, (1, 1), name="conv1")(x)
+        y = nn.relu(norm(name="bn1")(y))
+        y = conv(self.filters, (3, 3), strides=(self.stride, self.stride),
+                 name="conv2")(y)
+        y = nn.relu(norm(name="bn2")(y))
+        y = conv(4 * self.filters, (1, 1), name="conv3")(y)
+        # Zero-init the last BN scale: residual branch starts as identity,
+        # the standard trick for stable large-batch training.
+        y = norm(name="bn3", scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = conv(4 * self.filters, (1, 1),
+                            strides=(self.stride, self.stride),
+                            name="downsample_conv")(residual)
+            residual = norm(name="downsample_bn")(residual)
+        return nn.relu(y + residual)
+
+
+class ResNet(nn.Module):
+    stage_sizes: Sequence[int] = (3, 4, 6, 3)   # ResNet-50
+    num_classes: int = 1000
+    dtype: Dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        x = x.astype(self.dtype)
+        x = nn.Conv(64, (7, 7), strides=(2, 2), padding=[(3, 3), (3, 3)],
+                    use_bias=False, dtype=self.dtype, param_dtype=jnp.float32,
+                    name="conv_init")(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         epsilon=1e-5, dtype=self.dtype,
+                         param_dtype=jnp.float32, name="bn_init")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        for i, n_blocks in enumerate(self.stage_sizes):
+            for j in range(n_blocks):
+                x = BottleneckBlock(
+                    filters=64 * 2 ** i,
+                    stride=2 if j == 0 and i > 0 else 1,
+                    dtype=self.dtype,
+                    name=f"stage{i + 1}_block{j}")(x, train=train)
+        x = jnp.mean(x, axis=(1, 2))            # global average pool
+        x = nn.Dense(self.num_classes, dtype=self.dtype,
+                     param_dtype=jnp.float32, name="head")(x)
+        return x.astype(jnp.float32)
+
+
+def resnet50(num_classes: int = 1000, dtype=jnp.bfloat16) -> ResNet:
+    return ResNet((3, 4, 6, 3), num_classes, dtype)
+
+
+def resnet18_cifar(num_classes: int = 10, dtype=jnp.float32) -> ResNet:
+    """Small variant for tests/CI."""
+    return ResNet((1, 1, 1, 1), num_classes, dtype)
+
+
+def loss_fn(model: ResNet, variables, batch, rng=None,
+            label_smoothing: float = 0.1):
+    """Smoothed softmax CE; returns new batch_stats via mutable apply.
+
+    ``variables`` = {"params": ..., "batch_stats": ...}; aux carries accuracy
+    and the updated stats (caller merges them — BN state is part of the train
+    state on TPU just like anywhere else).
+    """
+    images, labels = batch["image"], batch["label"]
+    logits, updates = model.apply(variables, images, train=True,
+                                  mutable=["batch_stats"])
+    n = logits.shape[-1]
+    onehot = jax.nn.one_hot(labels, n) * (1 - label_smoothing) \
+        + label_smoothing / n
+    loss = optax.softmax_cross_entropy(logits, onehot).mean()
+    acc = (logits.argmax(-1) == labels).mean()
+    return loss, {"accuracy": acc, "batch_stats": updates["batch_stats"]}
+
+
+def flops_per_example(image_size: int = 224) -> float:
+    """~4.1 GFLOPs fwd for ResNet-50 @224; fwd+bwd ≈ 3×."""
+    return 3.0 * 4.1e9 * (image_size / 224) ** 2

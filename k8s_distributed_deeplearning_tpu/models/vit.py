@@ -1,0 +1,82 @@
+"""Vision Transformer (ViT-L/16 flagship) — BASELINE.json config #4.
+
+The config list names ViT-L as the "mixed data+tensor sharding" exercise: the
+encoder reuses the shared transformer core, so its logical axes inherit the
+same rule table — on a {"data": D, "tensor": T} mesh the MLP/head projections
+run Megatron-style sharded while the batch stays data-parallel, with zero
+model-side code for either.
+"""
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import optax
+
+from k8s_distributed_deeplearning_tpu.models.transformer import (
+    Transformer, TransformerConfig, embed_init)
+
+
+def config_vit_l16(**overrides) -> TransformerConfig:
+    base = dict(vocab_size=1, dim=1024, n_layers=24, n_heads=16,
+                mlp_dim=4096, max_seq_len=257, causal=False,
+                activation="gelu", norm="layernorm", position="none")
+    base.update(overrides)
+    return TransformerConfig(**base)
+
+
+def config_tiny(**overrides) -> TransformerConfig:
+    base = dict(vocab_size=1, dim=64, n_layers=2, n_heads=4, mlp_dim=128,
+                max_seq_len=65, causal=False, activation="gelu",
+                norm="layernorm", position="none")
+    base.update(overrides)
+    return TransformerConfig(**base)
+
+
+class ViT(nn.Module):
+    """Patchify -> [CLS] + learned pos -> encoder -> classification head."""
+
+    cfg: TransformerConfig
+    patch_size: int = 16
+    num_classes: int = 1000
+
+    @nn.compact
+    def __call__(self, images: jax.Array, *,
+                 deterministic: bool = True) -> jax.Array:
+        cfg = self.cfg
+        p = self.patch_size
+        x = nn.Conv(cfg.dim, (p, p), strides=(p, p), padding="VALID",
+                    dtype=cfg.dtype, param_dtype=jnp.float32,
+                    name="patch_embed")(images.astype(cfg.dtype))
+        b, h, w, d = x.shape
+        x = x.reshape(b, h * w, d)
+        cls = self.param("cls_token",
+                         nn.with_logical_partitioning(
+                             nn.initializers.zeros, (None, None, "embed")),
+                         (1, 1, cfg.dim), jnp.float32)
+        x = jnp.concatenate([jnp.broadcast_to(cls, (b, 1, d)).astype(cfg.dtype),
+                             x], axis=1)
+        pos = self.param("pos_embed",
+                         nn.with_logical_partitioning(
+                             embed_init, (None, None, "embed")),
+                         (1, h * w + 1, cfg.dim), jnp.float32)
+        x = x + pos.astype(cfg.dtype)
+        x = Transformer(cfg, name="encoder")(x, deterministic=deterministic)
+        x = x[:, 0]  # [CLS]
+        x = nn.Dense(self.num_classes, dtype=cfg.dtype,
+                     param_dtype=jnp.float32,
+                     kernel_init=nn.with_logical_partitioning(
+                         nn.initializers.zeros, ("embed", "vocab")),
+                     name="head")(x)
+        return x.astype(jnp.float32)
+
+
+def loss_fn(model: ViT, params, batch, rng=None, label_smoothing: float = 0.1):
+    images, labels = batch["image"], batch["label"]
+    logits = model.apply({"params": params}, images, deterministic=True)
+    n = logits.shape[-1]
+    onehot = jax.nn.one_hot(labels, n) * (1 - label_smoothing) \
+        + label_smoothing / n
+    loss = optax.softmax_cross_entropy(logits, onehot).mean()
+    acc = (logits.argmax(-1) == labels).mean()
+    return loss, {"accuracy": acc}

@@ -1,0 +1,311 @@
+"""Flash attention as a Pallas TPU kernel (fwd + custom-VJP bwd).
+
+The hot op of every transformer config in BASELINE.json. Design follows the
+flash-attention recurrence (online softmax), mapped to TPU:
+
+- grid (batch·heads, S_q/block_q): each program owns one query block in VMEM
+  and streams K/V blocks through the MXU with an f32 accumulator — the S×S
+  score matrix never exists in HBM, so attention becomes compute-bound on the
+  MXU instead of HBM-bandwidth-bound;
+- causal programs stop their K-loop at the diagonal block (trip count is a
+  function of the program id — ``fori_loop`` with a dynamic bound), so the
+  causal forward does ~half the FLOPs, matching the mask's sparsity;
+- the backward pass recomputes P from (Q, K, lse) per block — the standard
+  flash trade: O(S) extra FLOPs for never storing P — with separate dQ and
+  dK/dV kernels so each accumulates over its own grid without races;
+- off-TPU (CPU CI) the same kernels run with ``interpret=True``, so tests
+  exercise the identical code path the TPU compiles.
+
+Used via ``ops.attention.multi_head_attention(..., impl="flash")`` or the
+transformer configs' ``attention_impl="flash"``.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform in ("tpu", "axon")
+    except Exception:  # backend not initialized yet
+        return False
+
+
+def _block_sizes(sq: int, sk: int, target: int = 512) -> tuple[int, int]:
+    """Largest power-of-two block sizes ≤ target dividing the seq lengths."""
+    def pick(s):
+        b = 1
+        while b * 2 <= min(s, target) and s % (b * 2) == 0:
+            b *= 2
+        return b
+    return pick(sq), pick(sk)
+
+
+# ---------------------------------------------------------------- forward
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
+                scale: float, causal: bool, block_k: int, seq_k: int):
+    qi = pl.program_id(1)
+    block_q = q_ref.shape[1]
+    q = q_ref[0].astype(jnp.float32) * scale                    # [bq, d]
+
+    m = jnp.full((block_q,), NEG_INF, jnp.float32)
+    l = jnp.zeros((block_q,), jnp.float32)
+    acc = jnp.zeros((block_q, q.shape[-1]), jnp.float32)
+
+    if causal:
+        # Last K block that intersects the causal triangle of this Q block.
+        n_kb = (qi * block_q + block_q - 1) // block_k + 1
+        n_kb = jnp.minimum(n_kb, seq_k // block_k)
+    else:
+        n_kb = seq_k // block_k
+
+    def body(j, carry):
+        m, l, acc = carry
+        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)  # [bq, bk]
+        if causal:
+            row = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 0)
+            col = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 1)
+            s = jnp.where(row >= col, s, NEG_INF)
+        bm = jnp.max(s, axis=-1)
+        m_new = jnp.maximum(m, bm)
+        p = jnp.exp(s - m_new[:, None])
+        p = jnp.where(s <= NEG_INF / 2, 0.0, p)
+        alpha = jnp.exp(m - m_new)
+        l_new = alpha * l + jnp.sum(p, axis=-1)
+        acc_new = alpha[:, None] * acc + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    m, l, acc = jax.lax.fori_loop(0, n_kb, body, (m, l, acc))
+    norm = jnp.maximum(l, 1e-30)
+    o_ref[0] = (acc / norm[:, None]).astype(o_ref.dtype)
+    lse_ref[0, 0] = m + jnp.log(norm)
+
+
+def _fwd(q, k, v, *, causal, scale, interpret):
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    block_q, block_k = _block_sizes(sq, sk)
+    # Kernel layout: fold batch×heads, put seq×head_dim innermost.
+    qt = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    kt = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+    vt = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+
+    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                               block_k=block_k, seq_k=sk)
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=(b * h, sq // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda g, i: (g, i, 0)),
+            pl.BlockSpec((1, sk, d), lambda g, i: (g, 0, 0)),
+            pl.BlockSpec((1, sk, d), lambda g, i: (g, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda g, i: (g, i, 0)),
+            # lse rides as [bh, 1, sq]: TPU block rules need the last two dims
+            # (8,128)-aligned or full; a (1, block_q) block is neither.
+            pl.BlockSpec((1, 1, block_q), lambda g, i: (g, 0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, 1, sq), jnp.float32),
+        ],
+        cost_estimate=pl.CostEstimate(
+            flops=4 * b * h * sq * sk * d // (2 if causal else 1),
+            bytes_accessed=(qt.size + kt.size + vt.size) * qt.dtype.itemsize,
+            transcendentals=b * h * sq * sk),
+        interpret=interpret,
+    )(qt, kt, vt)
+    return o.reshape(b, h, sq, d).transpose(0, 2, 1, 3), lse
+
+
+# ---------------------------------------------------------------- backward
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
+                   scale: float, causal: bool, block_k: int, seq_k: int):
+    qi = pl.program_id(1)
+    block_q = q_ref.shape[1]
+    q = q_ref[0].astype(jnp.float32) * scale
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0, 0]
+    delta = delta_ref[0, 0]
+
+    if causal:
+        n_kb = (qi * block_q + block_q - 1) // block_k + 1
+        n_kb = jnp.minimum(n_kb, seq_k // block_k)
+    else:
+        n_kb = seq_k // block_k
+
+    def body(j, dq):
+        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            row = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            col = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(row >= col, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        p = jnp.where(s <= NEG_INF / 2, 0.0, p)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None])
+        return dq + jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
+                                        preferred_element_type=jnp.float32)
+
+    dq = jax.lax.fori_loop(0, n_kb,
+                           body, jnp.zeros_like(q))
+    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, *,
+                    scale: float, causal: bool, block_q: int, seq_q: int):
+    ki = pl.program_id(1)
+    block_k = k_ref.shape[1]
+    k = k_ref[0].astype(jnp.float32)
+    v = v_ref[0].astype(jnp.float32)
+
+    if causal:
+        # First Q block intersecting the triangle for this K block.
+        first_qb = (ki * block_k) // block_q
+    else:
+        first_qb = 0
+    n_qb = seq_q // block_q
+
+    def body(i, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32) * scale
+        do = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, 0, pl.ds(i * block_q, block_q)]
+        delta = delta_ref[0, 0, pl.ds(i * block_q, block_q)]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            row = i * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            col = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(row >= col, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        p = jnp.where(s <= NEG_INF / 2, 0.0, p)
+        dv = dv + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None])
+        dk = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+        return dk, dv
+
+    dk, dv = jax.lax.fori_loop(first_qb, n_qb, body,
+                               (jnp.zeros_like(k), jnp.zeros_like(v)))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _bwd(causal, scale, interpret, res, g):
+    q, k, v, o, lse = res
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    block_q, block_k = _block_sizes(sq, sk)
+
+    fold = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
+    qt, kt, vt, dot = fold(q), fold(k), fold(v), fold(g)
+    # delta_i = rowsum(dO_i * O_i) — the softmax-jacobian diagonal term.
+    delta = jnp.sum(dot.astype(jnp.float32)
+                    * fold(o).astype(jnp.float32), axis=-1)[:, None, :]  # [bh,1,sq]
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          block_k=block_k, seq_k=sk),
+        grid=(b * h, sq // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda g_, i: (g_, i, 0)),
+            pl.BlockSpec((1, sk, d), lambda g_, i: (g_, 0, 0)),
+            pl.BlockSpec((1, sk, d), lambda g_, i: (g_, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda g_, i: (g_, i, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda g_, i: (g_, 0, i)),
+            pl.BlockSpec((1, 1, block_q), lambda g_, i: (g_, 0, i)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda g_, i: (g_, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        interpret=interpret,
+    )(qt, kt, vt, dot, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q, seq_q=sq),
+        grid=(b * h, sk // block_k),
+        in_specs=[
+            pl.BlockSpec((1, sq, d), lambda g_, j: (g_, 0, 0)),
+            pl.BlockSpec((1, block_k, d), lambda g_, j: (g_, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda g_, j: (g_, j, 0)),
+            pl.BlockSpec((1, sq, d), lambda g_, j: (g_, 0, 0)),
+            pl.BlockSpec((1, 1, sq), lambda g_, j: (g_, 0, 0)),
+            pl.BlockSpec((1, 1, sq), lambda g_, j: (g_, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda g_, j: (g_, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda g_, j: (g_, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, sk, d), v.dtype),
+        ],
+        interpret=interpret,
+    )(qt, kt, vt, dot, lse, delta)
+
+    unfold = lambda x, s: x.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+    return unfold(dq, sq), unfold(dk, sk), unfold(dv, sk)
+
+
+# ---------------------------------------------------------------- public API
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash(q, k, v, causal, scale, interpret):
+    o, _ = _fwd(q, k, v, causal=causal, scale=scale, interpret=interpret)
+    return o
+
+
+def _flash_fwd(q, k, v, causal, scale, interpret):
+    o, lse = _fwd(q, k, v, causal=causal, scale=scale, interpret=interpret)
+    return o, (q, k, v, o, lse)
+
+
+_flash.defvjp(_flash_fwd,
+              lambda causal, scale, interpret, res, g:
+              _bwd(causal, scale, interpret, res, g))
+
+
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                    causal: bool = False,
+                    softmax_scale: float | None = None,
+                    interpret: bool | None = None) -> jax.Array:
+    """Flash attention, [B,S,H,D] layout, GQA via KV-head repeat.
+
+    ``interpret=None`` auto-selects: compiled on TPU, interpreter elsewhere
+    (CPU CI runs the same kernels). Sequence lengths must be divisible by the
+    chosen power-of-two block sizes (always true for the usual 2^k lengths).
+    """
+    hq, hkv = q.shape[2], k.shape[2]
+    if hkv != hq:
+        k = jnp.repeat(k, hq // hkv, axis=2)
+        v = jnp.repeat(v, hq // hkv, axis=2)
+    scale = softmax_scale if softmax_scale is not None else q.shape[-1] ** -0.5
+    if interpret is None:
+        interpret = not _on_tpu()
+    return _flash(q, k, v, causal, scale, interpret)

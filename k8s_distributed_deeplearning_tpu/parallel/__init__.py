@@ -14,3 +14,17 @@ from k8s_distributed_deeplearning_tpu.parallel.data_parallel import (  # noqa: F
     make_train_step,
     broadcast_params,
 )
+from k8s_distributed_deeplearning_tpu.parallel.sharding import (  # noqa: F401
+    DEFAULT_RULES,
+    ShardedTrainer,
+    resolve_rules,
+)
+from k8s_distributed_deeplearning_tpu.parallel.context_parallel import (  # noqa: F401
+    make_context_parallel_attention,
+    ring_attention,
+    ulysses_attention,
+)
+from k8s_distributed_deeplearning_tpu.parallel.pipeline import (  # noqa: F401
+    make_pipeline_fn,
+    pipeline_apply,
+)

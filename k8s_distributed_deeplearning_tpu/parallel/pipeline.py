@@ -1,0 +1,118 @@
+"""Pipeline parallelism: GPipe-style microbatched schedule over a mesh axis.
+
+Absent from the reference (SURVEY.md §2c — DP was its only strategy); built
+here because a complete TPU framework must span models deeper than one chip's
+HBM. Design is the shard_map-native schedule:
+
+- layer weights arrive **stacked** on a leading "layers" axis (exactly what
+  ``nn.scan`` produces in the transformer core) and sharded over the
+  ``"pipeline"`` mesh axis — stage p holds layers [p·L/P, (p+1)·L/P);
+- the batch is split into M microbatches; at tick t, stage p runs microbatch
+  t-p: activations hop stage→stage+1 through a **non-circular ppermute**
+  (neighbor ICI hop), giving the classic (P-1)/(M+P-1) bubble;
+- the whole schedule is a ``lax.scan`` over M+P-1 ticks — one compiled tick
+  body, so trace size is O(layers/stage), not O(ticks);
+- backward needs no separate schedule: JAX transposes the scan+ppermute into
+  the reverse pipeline automatically (ppermuteᵀ = reverse ppermute);
+- the last stage's outputs are rebroadcast with a masked-psum and the loss is
+  ``pmean``-ed over the pipeline axis, which both replicates the value and
+  makes the transpose sum to exactly the right cotangent (ḡ/P per stage,
+  psum → ḡ).
+
+Every stage computes every tick (SPMD) — bubble ticks process garbage that
+never reaches an output, the standard trade for compiler-friendly uniformity.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh
+from jax.sharding import PartitionSpec as P
+
+PyTree = Any
+
+
+def _apply_local_stack(block_fn: Callable, stacked_params: PyTree,
+                       x: jax.Array) -> jax.Array:
+    """Run this stage's layers sequentially: scan over the local layer axis."""
+    def body(carry, layer_params):
+        return block_fn(layer_params, carry), None
+    out, _ = lax.scan(body, x, stacked_params)
+    return out
+
+
+def pipeline_apply(block_fn: Callable, stacked_params: PyTree, x: jax.Array, *,
+                   num_microbatches: int,
+                   axis_name: str = "pipeline") -> jax.Array:
+    """GPipe forward over a stage-sharded layer stack — call inside shard_map.
+
+    ``block_fn(one_layer_params, x) -> x`` is a single layer; *stacked_params*
+    leaves are [L_local, ...] (this stage's shard); *x* is this device's batch
+    shard [B, ...] with B divisible by *num_microbatches*.
+    """
+    p = lax.axis_size(axis_name)
+    stage = lax.axis_index(axis_name)
+    m = num_microbatches
+    b = x.shape[0]
+    if b % m:
+        raise ValueError(f"batch {b} not divisible by {m} microbatches")
+    mb = b // m
+    micro = x.reshape(m, mb, *x.shape[1:])
+
+    fwd = functools.partial(_apply_local_stack, block_fn, stacked_params)
+    out0 = jax.eval_shape(fwd, jax.ShapeDtypeStruct((mb, *x.shape[1:]), x.dtype))
+    shift = [(i, i + 1) for i in range(p - 1)]  # non-circular stage hop
+
+    def tick(carry, t):
+        current, outputs = carry
+        inject = lax.dynamic_index_in_dim(micro, jnp.minimum(t, m - 1), 0,
+                                          keepdims=False)
+        inp = jnp.where(stage == 0, inject.astype(out0.dtype), current)
+        out = fwd(inp)
+        nxt = lax.ppermute(out, axis_name, shift)
+        midx = t - (p - 1)
+        updated = lax.dynamic_update_index_in_dim(
+            outputs, out, jnp.clip(midx, 0, m - 1), 0)
+        outputs = jnp.where((stage == p - 1) & (midx >= 0), updated, outputs)
+        return (nxt, outputs), None
+
+    current = jnp.zeros(out0.shape, out0.dtype)
+    outputs = jnp.zeros((m, *out0.shape), out0.dtype)
+    (_, outputs), _ = lax.scan(tick, (current, outputs),
+                               jnp.arange(m + p - 1))
+    # outputs is only real on the last stage: rebroadcast (masked psum).
+    mask = (stage == p - 1).astype(outputs.dtype)
+    outputs = lax.psum(outputs * mask, axis_name)
+    return outputs.reshape(b, *out0.shape[1:])
+
+
+def pipeline_loss(per_example_loss: Callable, axis_name: str = "pipeline"):
+    """Wrap a loss over pipeline outputs so each stage computes it and the
+    pmean makes value and gradients exact (see module docstring)."""
+    def fn(y, *args):
+        return lax.pmean(per_example_loss(y, *args), axis_name)
+    return fn
+
+
+def make_pipeline_fn(mesh: Mesh, block_fn: Callable, *,
+                     num_microbatches: int, axis_name: str = "pipeline",
+                     data_axes: tuple[str, ...] = ("data",)) -> Callable:
+    """Jit-level wrapper: ``fn(stacked_params, x) -> y`` with params sharded
+    over the pipeline axis (leading/layers dim) and batch over *data_axes*."""
+    batch = tuple(a for a in data_axes if a in mesh.axis_names) or None
+    pspec = P(axis_name)          # layer-stacked leaves: shard leading dim
+    xspec = P(batch)
+
+    def inner(stacked_params, x):
+        return pipeline_apply(block_fn, stacked_params, x,
+                              num_microbatches=num_microbatches,
+                              axis_name=axis_name)
+
+    return jax.jit(jax.shard_map(
+        inner, mesh=mesh,
+        in_specs=(pspec, xspec), out_specs=xspec,
+        check_vma=False))

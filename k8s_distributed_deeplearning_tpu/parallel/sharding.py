@@ -42,6 +42,7 @@ DEFAULT_RULES: Rules = (
     ("batch", ("data", "fsdp")),     # DP over data, and over fsdp (ZeRO data axis)
     ("seq", "sequence"),             # activation sequence sharding (CP)
     ("embed", "fsdp"),               # FSDP weight shard axis
+    ("embed_out", None),             # square-projection output dim (dedup)
     ("mlp", "tensor"),               # Megatron column-parallel
     ("heads", "tensor"),             # attention-head parallel
     ("kv", "tensor"),
