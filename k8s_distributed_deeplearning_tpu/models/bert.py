@@ -7,13 +7,15 @@ list names (~110M params of mostly-dense gradients every step).
 """
 from __future__ import annotations
 
+import dataclasses
+
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
 import optax
 
 from k8s_distributed_deeplearning_tpu.models.transformer import (
-    Transformer, TransformerConfig, embed_init)
+    LMHead, Transformer, TransformerConfig)
 
 
 def config_bert_base(**overrides) -> TransformerConfig:
@@ -53,13 +55,14 @@ class BertMLM(nn.Module):
         x = nn.gelu(x)
         x = nn.LayerNorm(dtype=cfg.dtype, param_dtype=jnp.float32,
                          name="mlm_norm")(x)
+        # MLM decode ties to the input embedding unconditionally (BERT
+        # semantics), independent of cfg.tie_embeddings.
         embedding = self.variables["params"]["encoder"]["tok_embed"]["embedding"]
-        embedding = nn.meta.unbox(embedding)
-        logits = jnp.einsum("bsd,vd->bsv", x, embedding.astype(cfg.dtype),
-                            preferred_element_type=jnp.float32)
+        tied_cfg = dataclasses.replace(cfg, tie_embeddings=True)
+        logits = LMHead(tied_cfg, name="mlm_decode")(x, nn.meta.unbox(embedding))
         bias = self.param("mlm_bias", nn.initializers.zeros,
                           (cfg.vocab_size,), jnp.float32)
-        return logits.astype(jnp.float32) + bias
+        return logits + bias
 
 
 def mask_tokens(tokens: jax.Array, rng: jax.Array, *, vocab_size: int,

@@ -21,6 +21,7 @@ aux loss + router z-loss exposed via ``sow("intermediates", ...)``.
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Any
 
 import flax.linen as nn
@@ -29,7 +30,7 @@ import jax.numpy as jnp
 import optax
 
 from k8s_distributed_deeplearning_tpu.models.transformer import (
-    Attention, TransformerConfig, default_init, embed_init, make_norm)
+    LMHead, Transformer, TransformerConfig, default_init)
 
 Dtype = Any
 
@@ -151,45 +152,25 @@ class MoEMLP(nn.Module):
         return y.reshape(b, s, d)
 
 
-class MoEBlock(nn.Module):
-    """Pre-norm block with MoE feed-forward."""
-
-    cfg: TransformerConfig
-    moe: MoEConfig
-
-    @nn.compact
-    def __call__(self, x, *, positions=None, attention_fn=None):
-        cfg = self.cfg
-        h = make_norm(cfg, "attn_norm")(x)
-        h = Attention(cfg, name="attn")(h, positions=positions,
-                                        attention_fn=attention_fn)
-        x = x + h
-        h = make_norm(cfg, "mlp_norm")(x)
-        h = MoEMLP(cfg, self.moe, name="moe")(h)
-        x = x + h
-        return nn.with_logical_constraint(x, ("batch", "seq", "embed"))
-
-
 class MoELM(nn.Module):
-    """Decoder-only MoE language model (every layer MoE, GShard-dense layout)."""
+    """Decoder-only MoE language model (every layer MoE, GShard-dense layout).
+
+    Rides the shared :class:`~models.transformer.Transformer` core with
+    ``mlp_factory`` swapping the dense MLP for :class:`MoEMLP`, so scan_layers
+    / remat / dropout all work for MoE exactly as for dense models.
+    """
 
     cfg: TransformerConfig
     moe: MoEConfig
 
     @nn.compact
-    def __call__(self, tokens, *, positions=None, attention_fn=None):
-        cfg = self.cfg
-        x = nn.Embed(cfg.vocab_size, cfg.dim, dtype=cfg.dtype,
-                     param_dtype=jnp.float32,
-                     embedding_init=nn.with_logical_partitioning(
-                         embed_init, ("vocab", "embed")),
-                     name="tok_embed")(tokens)
-        for i in range(cfg.n_layers):
-            x = MoEBlock(cfg, self.moe, name=f"block_{i}")(
-                x, positions=positions, attention_fn=attention_fn)
-        x = make_norm(cfg, "final_norm")(x)
-        from k8s_distributed_deeplearning_tpu.models.transformer import LMHead
-        return LMHead(cfg, name="head")(x)
+    def __call__(self, tokens, *, positions=None, attention_fn=None,
+                 deterministic: bool = True):
+        factory = functools.partial(MoEMLP, moe=self.moe)
+        x = Transformer(self.cfg, mlp_factory=factory, name="transformer")(
+            tokens, positions=positions, deterministic=deterministic,
+            attention_fn=attention_fn)
+        return LMHead(self.cfg, name="head")(x)
 
 
 def loss_fn(model: MoELM, moe: MoEConfig, params, batch, rng=None):
@@ -202,8 +183,11 @@ def loss_fn(model: MoELM, moe: MoEConfig, params, batch, rng=None):
     flat = jax.tree_util.tree_flatten_with_path(state["intermediates"])[0]
     lb = [v for path, v in flat if "load_balance_loss" in str(path)]
     zs = [v for path, v in flat if "router_z_loss" in str(path)]
-    aux_loss = (moe.aux_loss_weight * sum(jnp.mean(l) for l in lb)
-                + moe.router_z_weight * sum(jnp.mean(z) for z in zs))
+    # SUM over layers: under nn.scan the per-layer sows stack into one
+    # [n_layers] leaf, under the python loop they are n_layers scalar leaves —
+    # jnp.sum makes both aggregate identically.
+    aux_loss = (moe.aux_loss_weight * sum(jnp.sum(l) for l in lb)
+                + moe.router_z_weight * sum(jnp.sum(z) for z in zs))
     loss = ce + aux_loss
     acc = (logits.argmax(-1) == targets).mean()
     return loss, {"ce": ce, "aux_loss": aux_loss, "accuracy": acc}

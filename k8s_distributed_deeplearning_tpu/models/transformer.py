@@ -224,9 +224,15 @@ class MLP(nn.Module):
 
 
 class Block(nn.Module):
-    """Pre-norm transformer block: x + attn(norm(x)); x + mlp(norm(x))."""
+    """Pre-norm transformer block: x + attn(norm(x)); x + mlp(norm(x)).
+
+    ``mlp_factory(cfg, name=...)`` swaps the feed-forward module (e.g. the
+    expert-parallel :class:`models.moe.MoEMLP`) while keeping the block's
+    norm/residual/dropout structure — and therefore scan/remat — shared.
+    """
 
     cfg: TransformerConfig
+    mlp_factory: Callable | None = None
 
     @nn.compact
     def __call__(self, x: jax.Array, *,
@@ -242,7 +248,7 @@ class Block(nn.Module):
             h = nn.Dropout(cfg.dropout_rate, deterministic=deterministic)(h)
         x = x + h
         h = make_norm(cfg, "mlp_norm")(x)
-        h = MLP(cfg, name="mlp")(h)
+        h = (self.mlp_factory or MLP)(cfg, name="mlp")(h)
         if cfg.dropout_rate:
             h = nn.Dropout(cfg.dropout_rate, deterministic=deterministic)(h)
         x = x + h
@@ -259,6 +265,7 @@ class Transformer(nn.Module):
     """
 
     cfg: TransformerConfig
+    mlp_factory: Callable | None = None
 
     @nn.compact
     def __call__(self, tokens_or_embeds: jax.Array, *,
@@ -296,14 +303,16 @@ class Transformer(nn.Module):
                     mdl(carry, mask=mask, positions=positions,
                         deterministic=deterministic,
                         attention_fn=attention_fn), None),
-                variable_axes={"params": 0},
+                variable_axes={"params": 0, "intermediates": 0},
                 split_rngs={"params": True, "dropout": True},
                 length=cfg.n_layers,
                 metadata_params={nn.PARTITION_NAME: "layers"},
-            )(block_cls(cfg, name="blocks"), x, None)
+            )(block_cls(cfg, mlp_factory=self.mlp_factory, name="blocks"),
+              x, None)
         else:
             for i in range(cfg.n_layers):
-                x = block_cls(cfg, name=f"block_{i}")(
+                x = block_cls(cfg, mlp_factory=self.mlp_factory,
+                              name=f"block_{i}")(
                     x, mask=mask, positions=positions,
                     deterministic=deterministic, attention_fn=attention_fn)
         return make_norm(cfg, "final_norm")(x)

@@ -51,7 +51,8 @@ def _block_sizes(sq: int, sk: int, target: int = 512) -> tuple[int, int]:
 # ---------------------------------------------------------------- forward
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
-                scale: float, causal: bool, block_k: int, seq_k: int):
+                scale: float, causal: bool, block_k: int, seq_k: int,
+                off: int):
     qi = pl.program_id(1)
     block_q = q_ref.shape[1]
     q = q_ref[0].astype(jnp.float32) * scale                    # [bq, d]
@@ -61,9 +62,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
     acc = jnp.zeros((block_q, q.shape[-1]), jnp.float32)
 
     if causal:
-        # Last K block that intersects the causal triangle of this Q block.
-        n_kb = (qi * block_q + block_q - 1) // block_k + 1
-        n_kb = jnp.minimum(n_kb, seq_k // block_k)
+        # Last K block intersecting the causal triangle of this Q block; the
+        # diagonal sits at col == row + off (off = Sk - Sq, decode alignment,
+        # matching ops/attention.py's reference mask).
+        n_kb = (qi * block_q + block_q - 1 + off) // block_k + 1
+        n_kb = jnp.clip(n_kb, 0, seq_k // block_k)
     else:
         n_kb = seq_k // block_k
 
@@ -78,7 +81,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
                 jnp.int32, s.shape, 0)
             col = j * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, s.shape, 1)
-            s = jnp.where(row >= col, s, NEG_INF)
+            s = jnp.where(row + off >= col, s, NEG_INF)
         bm = jnp.max(s, axis=-1)
         m_new = jnp.maximum(m, bm)
         p = jnp.exp(s - m_new[:, None])
@@ -106,7 +109,7 @@ def _fwd(q, k, v, *, causal, scale, interpret):
     vt = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
 
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                               block_k=block_k, seq_k=sk)
+                               block_k=block_k, seq_k=sk, off=sk - sq)
     o, lse = pl.pallas_call(
         kernel,
         grid=(b * h, sq // block_q),
@@ -137,7 +140,8 @@ def _fwd(q, k, v, *, causal, scale, interpret):
 # ---------------------------------------------------------------- backward
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
-                   scale: float, causal: bool, block_k: int, seq_k: int):
+                   scale: float, causal: bool, block_k: int, seq_k: int,
+                   off: int):
     qi = pl.program_id(1)
     block_q = q_ref.shape[1]
     q = q_ref[0].astype(jnp.float32) * scale
@@ -146,8 +150,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
     delta = delta_ref[0, 0]
 
     if causal:
-        n_kb = (qi * block_q + block_q - 1) // block_k + 1
-        n_kb = jnp.minimum(n_kb, seq_k // block_k)
+        n_kb = (qi * block_q + block_q - 1 + off) // block_k + 1
+        n_kb = jnp.clip(n_kb, 0, seq_k // block_k)
     else:
         n_kb = seq_k // block_k
 
@@ -159,7 +163,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
         if causal:
             row = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
             col = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            s = jnp.where(row >= col, s, NEG_INF)
+            s = jnp.where(row + off >= col, s, NEG_INF)
         p = jnp.exp(s - lse[:, None])
         p = jnp.where(s <= NEG_INF / 2, 0.0, p)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
@@ -175,15 +179,18 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, *,
-                    scale: float, causal: bool, block_q: int, seq_q: int):
+                    scale: float, causal: bool, block_q: int, seq_q: int,
+                    off: int):
     ki = pl.program_id(1)
     block_k = k_ref.shape[1]
     k = k_ref[0].astype(jnp.float32)
     v = v_ref[0].astype(jnp.float32)
 
     if causal:
-        # First Q block intersecting the triangle for this K block.
-        first_qb = (ki * block_k) // block_q
+        # First Q block intersecting the triangle for this K block: the first
+        # query row that can see col ki*block_k is row = col - off.
+        first_qb = jnp.maximum(ki * block_k - off, 0) // block_q
+        first_qb = jnp.minimum(first_qb, seq_q // block_q)
     else:
         first_qb = 0
     n_qb = seq_q // block_q
@@ -199,7 +206,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         if causal:
             row = i * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
             col = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            s = jnp.where(row >= col, s, NEG_INF)
+            s = jnp.where(row + off >= col, s, NEG_INF)
         p = jnp.exp(s - lse[:, None])
         p = jnp.where(s <= NEG_INF / 2, 0.0, p)
         dv = dv + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
@@ -231,7 +238,7 @@ def _bwd(causal, scale, interpret, res, g):
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                          block_k=block_k, seq_k=sk),
+                          block_k=block_k, seq_k=sk, off=sk - sq),
         grid=(b * h, sq // block_q),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda g_, i: (g_, i, 0)),
@@ -248,7 +255,7 @@ def _bwd(causal, scale, interpret, res, g):
 
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                          block_q=block_q, seq_q=sq),
+                          block_q=block_q, seq_q=sq, off=sk - sq),
         grid=(b * h, sk // block_k),
         in_specs=[
             pl.BlockSpec((1, sq, d), lambda g_, j: (g_, 0, 0)),
@@ -301,10 +308,10 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     (CPU CI runs the same kernels). Sequence lengths must be divisible by the
     chosen power-of-two block sizes (always true for the usual 2^k lengths).
     """
-    hq, hkv = q.shape[2], k.shape[2]
-    if hkv != hq:
-        k = jnp.repeat(k, hq // hkv, axis=2)
-        v = jnp.repeat(v, hq // hkv, axis=2)
+    from k8s_distributed_deeplearning_tpu.ops.attention import _repeat_kv
+    hq = q.shape[2]
+    k = _repeat_kv(k, hq)
+    v = _repeat_kv(v, hq)
     scale = softmax_scale if softmax_scale is not None else q.shape[-1] ** -0.5
     if interpret is None:
         interpret = not _on_tpu()

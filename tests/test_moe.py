@@ -89,6 +89,51 @@ def test_expert_weights_sharded_on_expert_mesh():
     import flax
     flat = flax.traverse_util.flatten_dict(
         sharding.unbox(state.params), sep="/")
-    w = next(v for k, v in flat.items() if k.endswith("moe/w_gate"))
+    w = next(v for k, v in flat.items() if k.endswith("mlp/w_gate"))
     assert not w.sharding.is_fully_replicated
     assert "expert" in (w.sharding.spec[0] or ())
+
+
+def test_moe_scan_layers_and_remat():
+    """MoE must ride the shared transformer core: scan_layers/remat work and
+    sown router metrics survive the scan (stacked along the layer axis)."""
+    cfg = llama.config_tiny(dtype=jnp.float32, n_layers=3, scan_layers=True,
+                            remat=True)
+    mcfg = moe.MoEConfig(num_experts=4, top_k=2, capacity_factor=2.0)
+    model = moe.MoELM(cfg, mcfg)
+    tokens = jax.random.randint(jax.random.key(0), (2, 16), 0, cfg.vocab_size)
+    params = model.init(jax.random.key(1), tokens)["params"]
+    loss, aux = moe.loss_fn(model, mcfg, params, {"tokens": tokens})
+    assert jnp.isfinite(loss)
+    assert float(aux["aux_loss"]) > 0.0
+    grads = jax.grad(lambda p: moe.loss_fn(model, mcfg, p,
+                                           {"tokens": tokens})[0])(params)
+    assert all(jnp.isfinite(g).all() for g in jax.tree.leaves(grads))
+
+
+def test_moe_scan_matches_loop_with_same_weights():
+    """Same weights, scan vs loop layer stacking: identical loss AND identical
+    aux loss (sum over layers — scan stacks the sown metrics into one leaf)."""
+    import dataclasses
+    import flax.linen as nn
+    cfg_loop = llama.config_tiny(dtype=jnp.float32, n_layers=3,
+                                 scan_layers=False)
+    cfg_scan = dataclasses.replace(cfg_loop, scan_layers=True)
+    mcfg = moe.MoEConfig(num_experts=4, top_k=2, capacity_factor=2.0)
+    m_loop, m_scan = moe.MoELM(cfg_loop, mcfg), moe.MoELM(cfg_scan, mcfg)
+    tokens = jax.random.randint(jax.random.key(0), (2, 16), 0,
+                                cfg_loop.vocab_size)
+
+    p = nn.meta.unbox(m_loop.init(jax.random.key(1), tokens)["params"])
+    tr = p["transformer"]
+    blocks = [tr[f"block_{i}"] for i in range(cfg_loop.n_layers)]
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *blocks)
+    p_scan = {"transformer": {"blocks": stacked, "tok_embed": tr["tok_embed"],
+                              "final_norm": tr["final_norm"]},
+              "head": p["head"]}
+
+    l_loop, a_loop = moe.loss_fn(m_loop, mcfg, p, {"tokens": tokens})
+    l_scan, a_scan = moe.loss_fn(m_scan, mcfg, p_scan, {"tokens": tokens})
+    np.testing.assert_allclose(float(l_scan), float(l_loop), rtol=1e-5)
+    np.testing.assert_allclose(float(a_scan["aux_loss"]),
+                               float(a_loop["aux_loss"]), rtol=1e-5)

@@ -73,3 +73,35 @@ def test_flash_under_jit_and_dispatch():
         q, k, v, causal=True, impl="flash"))(q, k, v)
     ref = attn_ops.dot_product_attention(q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+@pytest.mark.parametrize("sq,sk", [(32, 128), (16, 64)])
+def test_flash_causal_decode_alignment(sq, sk):
+    """Causal with Sq != Sk must align the diagonal at col == row + (Sk-Sq),
+    matching the reference mask (attention.py decode semantics)."""
+    q, k, v = _qkv(sq=sq, sk=sk)
+    ref = attn_ops.dot_product_attention(q, k, v, causal=True)
+    out = pallas_flash.flash_attention(q, k, v, causal=True, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_flash_causal_decode_grads_match():
+    q, k, v = _qkv(sq=16, sk=64)
+
+    def loss_ref(q, k, v):
+        return attn_ops.dot_product_attention(q, k, v, causal=True).sum()
+
+    def loss_flash(q, k, v):
+        return pallas_flash.flash_attention(q, k, v, causal=True,
+                                            interpret=True).sum()
+
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    g_fl = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_ref, g_fl):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a), atol=3e-5)
+
+
+def test_flash_rejects_indivisible_gqa():
+    q, k, v = _qkv(h=4, hkv=3)
+    with pytest.raises(ValueError, match="not divisible"):
+        pallas_flash.flash_attention(q, k, v, interpret=True)
