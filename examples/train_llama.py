@@ -1,0 +1,223 @@
+"""Distributed Llama-family LM pretraining — the flagship training script.
+
+Single-program, rank-parameterized (same contract as ``train_mnist.py`` and
+the reference's per-rank scripts, ``deploy_stack.sh:64-84``): every host runs
+this file; the K8s-injected env forms the world; the mesh axes requested on
+the CLI are laid over the global device set and XLA derives the collectives.
+
+Parallelism is fully flag-driven — any mix of:
+  --dp N     data parallelism               (gradient all-reduce)
+  --fsdp N   ZeRO-3-style param sharding    (all-gather + reduce-scatter)
+  --tp N     Megatron-style tensor parallel (sharded matmuls + psum)
+  --sp N     sequence/context parallel      (ring attention over ICI)
+
+Examples:
+  # single host, 8-chip FSDP x TP:
+  python examples/train_llama.py --preset small --fsdp 4 --tp 2
+  # CPU CI (8 virtual devices), tiny model, ring attention:
+  JAX_PLATFORM_NAME=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      python examples/train_llama.py --preset tiny --dp 2 --sp 4 \
+          --attention ring --num-steps 20
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from k8s_distributed_deeplearning_tpu import config as cfg
+from k8s_distributed_deeplearning_tpu.models import llama
+from k8s_distributed_deeplearning_tpu.parallel import (
+    context_parallel as cp,
+    distributed,
+    mesh as mesh_lib,
+    sharding,
+)
+from k8s_distributed_deeplearning_tpu.train import (
+    Checkpointer,
+    data as data_lib,
+    loop,
+)
+from k8s_distributed_deeplearning_tpu.train.preemption import PreemptionHandler
+from k8s_distributed_deeplearning_tpu.utils.metrics import MetricsLogger
+from k8s_distributed_deeplearning_tpu.utils.profiling import StepProfiler
+
+PRESETS = {
+    # name: overrides on llama.config_tiny / config_llama3_8b
+    "tiny": dict(vocab_size=256, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+                 mlp_dim=128, max_seq_len=512),
+    "small": dict(vocab_size=32000, dim=768, n_layers=12, n_heads=12,
+                  n_kv_heads=4, mlp_dim=2048, max_seq_len=2048),
+    "1b": dict(vocab_size=32000, dim=2048, n_layers=16, n_heads=32,
+               n_kv_heads=8, mlp_dim=8192, max_seq_len=4096, remat=True),
+    "8b": dict(),          # the true Llama-3 8B architecture numbers
+}
+
+
+def build_config(args) -> "llama.TransformerConfig":
+    overrides = dict(PRESETS[args.preset])
+    if args.preset == "8b":
+        base = llama.config_llama3_8b
+    else:
+        base = llama.config_tiny
+    if args.seq_len:
+        overrides["max_seq_len"] = max(args.seq_len,
+                                       overrides.get("max_seq_len", 0))
+    overrides["dtype"] = (jnp.bfloat16 if args.dtype == "bfloat16"
+                          else jnp.float32)
+    overrides["remat"] = args.remat or overrides.get("remat", False)
+    if args.attention == "flash":
+        overrides["attention_impl"] = "flash"
+    return base(**overrides)
+
+
+def main(argv: list[str] | None = None) -> dict:
+    parser = argparse.ArgumentParser(description=__doc__)
+    cfg.add_train_flags(parser)
+    parser.add_argument("--preset", choices=sorted(PRESETS), default="tiny")
+    parser.add_argument("--seq-len", type=int, default=None,
+                        help="training sequence length (default: preset's)")
+    parser.add_argument("--dp", type=int, default=-1, help="data axis (-1: rest)")
+    parser.add_argument("--fsdp", type=int, default=1)
+    parser.add_argument("--tp", type=int, default=1)
+    parser.add_argument("--sp", type=int, default=1,
+                        help="sequence-parallel axis (ring attention)")
+    parser.add_argument("--attention", choices=["xla", "flash", "ring", "ulysses"],
+                        default="xla")
+    parser.add_argument("--remat", action="store_true",
+                        help="checkpoint each block (long-context memory lever)")
+    parser.add_argument("--data-path", type=str, default=None,
+                        help="byte-level corpus file; default synthetic tokens")
+    parser.add_argument("--profile-dir", type=str, default=None,
+                        help="capture a jax.profiler trace of steps 10..15")
+    args = parser.parse_args(argv)
+    conf = cfg.train_config_from_args(args)
+
+    distributed.initialize_from_env()
+    topo = mesh_lib.topology()
+    use_cp = args.sp > 1 or args.attention in ("ring", "ulysses")
+    axes = {"data": args.dp, "fsdp": args.fsdp, "tensor": args.tp,
+            "sequence": args.sp}
+    # Keep size-1 axes out of the mesh — except "sequence" when context-
+    # parallel attention is requested, whose shard_map specs name that axis.
+    mesh = mesh_lib.make_mesh({
+        k: v for k, v in axes.items()
+        if v != 1 or k == "data" or (k == "sequence" and use_cp)})
+
+    model_cfg = build_config(args)
+    seq_len = args.seq_len or min(model_cfg.max_seq_len, 512)
+    model = llama.LlamaLM(model_cfg)
+
+    attention_fn = None
+    if use_cp:
+        impl = args.attention if args.attention in ("ring", "ulysses") else "ring"
+        attention_fn = cp.make_context_parallel_attention(mesh, impl)
+
+    def loss(params, batch, rng):
+        toks = batch["tokens"]
+        inputs, targets = toks[:, :-1], toks[:, 1:]
+        rngs = {"dropout": rng} if rng is not None else None
+        logits = model.apply({"params": params}, inputs,
+                             deterministic=rng is None, rngs=rngs,
+                             attention_fn=attention_fn)
+        ce = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
+        acc = (logits.argmax(-1) == targets).mean()
+        return ce.mean(), {"accuracy": acc, "perplexity": jnp.exp(ce.mean())}
+
+    # LM convention: --num-steps is the optimizer-step budget as given (the
+    # reference's steps//world rule, tensorflow_mnist.py:146, presumes a fixed
+    # total-sample budget — for LM runs the step budget is the contract).
+    num_steps = conf.num_steps
+    optimizer = optax.adamw(conf.lr, weight_decay=0.1)
+    trainer = sharding.ShardedTrainer(loss, optimizer, mesh)
+    init = lambda r: model.init(r, jnp.zeros((1, 8), jnp.int32))["params"]
+    state = trainer.init(init, jax.random.key(conf.seed))
+    step_fn = trainer.make_step(donate=False)
+
+    tokens = data_lib.load_tokens(args.data_path,
+                                  vocab_size=model_cfg.vocab_size,
+                                  seed=conf.seed)
+    # Hold out the corpus tail for eval — disjoint from every training epoch
+    # (each epoch permutes the SAME training windows, so "future step indices"
+    # are not held out).
+    n_eval = max(2 * (seq_len + 1), int(0.05 * len(tokens)))
+    eval_tokens, tokens = tokens[-n_eval:], tokens[:-n_eval]
+    # Per-host batch: the global batch split across processes (each host
+    # contributes its local slice; shard_batch assembles the global array).
+    global_batch = conf.batch_size
+    per_host = max(1, global_batch // topo.num_processes)
+    batcher = data_lib.TokenBatcher(tokens, per_host, seq_len,
+                                    seed=conf.seed,
+                                    process_index=topo.process_index,
+                                    num_processes=topo.num_processes)
+
+    metrics = MetricsLogger(enabled=distributed.is_primary(), job="llama")
+    ckpt = Checkpointer(conf.checkpoint_dir,
+                        max_to_keep=conf.max_checkpoints_to_keep)
+    preemption = PreemptionHandler.install()
+    profiler = (StepProfiler(args.profile_dir, start_step=10, num_steps=5,
+                             enabled=distributed.is_primary())
+                if args.profile_dir else None)
+
+    n_params = sum(x.size for x in jax.tree.leaves(sharding.unbox(state.params)))
+    metrics.emit("start", world_size=topo.world_size, num_steps=num_steps,
+                 preset=args.preset, params=n_params, seq_len=seq_len,
+                 mesh={k: int(v) for k, v in
+                       zip(mesh.axis_names, mesh.devices.shape)},
+                 attention=args.attention, platform=topo.platform)
+
+    def global_batches(start_step: int):
+        return (trainer.shard_batch(b) for b in batcher.iter_from(start_step))
+
+    flops_per_example = llama.flops_per_token(model_cfg) * seq_len
+    state = loop.fit(
+        step_fn, state, global_batches, num_steps, jax.random.key(conf.seed),
+        metrics=metrics, checkpointer=ckpt,
+        checkpoint_every=conf.checkpoint_every, log_every=conf.log_every,
+        global_batch_size=global_batch,
+        flops_per_example=flops_per_example,
+        peak_flops=mesh_lib.peak_flops_per_device(args.dtype),
+        preemption=preemption, profiler=profiler,
+    )
+
+    result: dict = {"num_steps": int(jax.device_get(state.step)),
+                    "world_size": topo.world_size, "params": int(n_params)}
+    # Skip eval when preempted: the grace period is for checkpointing, and an
+    # "eval" event would make an evicted run look like a completed one.
+    if conf.eval_final and not preemption.triggered:
+        # Held-out perplexity on the reserved corpus tail, sharded across
+        # processes like training data.
+        windows_per_proc = ((len(eval_tokens) - 1) // seq_len
+                            ) // topo.num_processes
+        if windows_per_proc < 1:
+            metrics.emit("eval_skipped", reason="held-out set smaller than "
+                         "one window per process")
+        else:
+            eval_batcher = data_lib.TokenBatcher(
+                eval_tokens, min(per_host, windows_per_proc), seq_len,
+                seed=conf.seed, process_index=topo.process_index,
+                num_processes=topo.num_processes)
+            eval_step = jax.jit(lambda p, b: loss(p, b, None)[0])
+            n_batches = min(4, eval_batcher.batches_per_epoch)
+            eval_losses = [
+                float(eval_step(state.params,
+                                trainer.shard_batch(eval_batcher.batch_at(s))))
+                for s in range(n_batches)]
+            import math
+            ev = sum(eval_losses) / len(eval_losses)
+            metrics.emit("eval", loss=ev, perplexity=math.exp(ev))
+            result["eval_loss"] = ev
+    preemption.uninstall()
+    ckpt.close()
+    metrics.close()
+    return result
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

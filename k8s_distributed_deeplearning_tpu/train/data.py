@@ -82,6 +82,108 @@ def load_or_synthesize(data_dir: str | None, split: str = "train",
                            sample_seed=seed if split == "train" else seed + 10_000)
 
 
+def synthetic_tokens(num_tokens: int = 1 << 17, vocab_size: int = 256,
+                     seed: int = 0, order_prob: float = 0.9) -> np.ndarray:
+    """Procedural token corpus with learnable structure (zero-egress stand-in
+    for a text dataset): a seeded bigram chain — each token follows its
+    designated successor with probability *order_prob*, else is uniform noise.
+    A causal LM's achievable next-token accuracy is therefore ≈ order_prob,
+    giving tests and smoke runs a meaningful convergence target.
+    """
+    rng = np.random.default_rng(seed)
+    successor = rng.integers(0, vocab_size, size=(vocab_size,))
+    noise = rng.integers(0, vocab_size, size=(num_tokens,))
+    follow = rng.random(num_tokens) < order_prob
+    toks = np.empty(num_tokens, np.int32)
+    toks[0] = noise[0]
+    for i in range(1, num_tokens):
+        toks[i] = successor[toks[i - 1]] if follow[i] else noise[i]
+    return toks
+
+
+def load_tokens(path: str | None, *, num_tokens: int = 1 << 17,
+                vocab_size: int = 256, seed: int = 0) -> np.ndarray:
+    """Byte-level tokens from a file, or the synthetic corpus when no path.
+
+    Like :func:`load_or_synthesize`, an explicitly requested path that doesn't
+    exist is an error — never silently train on fake data.
+    """
+    if path:
+        if not os.path.exists(path):
+            raise FileNotFoundError(
+                f"--data-path {path!r} does not exist; omit it for synthetic "
+                "tokens")
+        raw = np.fromfile(path, dtype=np.uint8)
+        return raw.astype(np.int32)
+    return synthetic_tokens(num_tokens, vocab_size, seed)
+
+
+class TokenBatcher:
+    """Infinite LM batches: disjoint seq_len+1 windows, epoch-shuffled,
+    per-host disjoint — the language-model analog of :class:`ShardedBatcher`
+    (same stateless ``batch_at`` contract, so checkpoint resume is
+    replay-free).
+    """
+
+    def __init__(self, tokens: np.ndarray, batch_size: int, seq_len: int,
+                 seed: int = 0, process_index: int = 0, num_processes: int = 1):
+        if batch_size <= 0:
+            raise ValueError("batch_size must be positive")
+        if seq_len <= 0:
+            raise ValueError("seq_len must be positive")
+        self.tokens = np.ascontiguousarray(tokens, dtype=np.int32)
+        self.batch_size = batch_size
+        self.seq_len = seq_len
+        self.seed = seed
+        self.process_index = process_index
+        self.num_processes = num_processes
+        self.num_windows = (len(self.tokens) - 1) // seq_len
+        if self.num_windows < 1:
+            raise ValueError(
+                f"corpus of {len(self.tokens)} tokens too small for "
+                f"seq_len={seq_len}")
+        self._epoch_cache: tuple[int, np.ndarray] | None = None
+        # Shard size is epoch-independent, so bpe is a constant — computed
+        # once, not via an O(num_windows) permutation per batch.
+        shard_len = len(range(process_index, self.num_windows, num_processes))
+        self._bpe = shard_len // batch_size
+        if self._bpe == 0:
+            raise ValueError(
+                f"per-host shard ({shard_len} windows) is smaller than "
+                f"batch_size={batch_size}")
+
+    def shard_indices(self, epoch: int) -> np.ndarray:
+        # Memoized per epoch: the permutation is O(num_windows) host work in
+        # the synchronous data path.
+        if self._epoch_cache is None or self._epoch_cache[0] != epoch:
+            rng = np.random.default_rng((self.seed, epoch))
+            perm = rng.permutation(self.num_windows)
+            self._epoch_cache = (epoch,
+                                 perm[self.process_index::self.num_processes])
+        return self._epoch_cache[1]
+
+    @property
+    def batches_per_epoch(self) -> int:
+        return self._bpe
+
+    def batch_at(self, step: int) -> PyTree:
+        epoch, pos = divmod(step, self._bpe)
+        idx = self.shard_indices(epoch)
+        sel = idx[pos * self.batch_size:(pos + 1) * self.batch_size]
+        # Window w covers tokens [w*S, w*S + S]: S inputs + 1 shifted target.
+        rows = sel[:, None] * self.seq_len + np.arange(self.seq_len + 1)
+        return {"tokens": self.tokens[rows]}
+
+    def iter_from(self, start_step: int = 0) -> Iterator[PyTree]:
+        step = start_step
+        while True:
+            yield self.batch_at(step)
+            step += 1
+
+    def __iter__(self) -> Iterator[PyTree]:
+        return self.iter_from(0)
+
+
 class ShardedBatcher:
     """Infinite iterator of per-host batches with true epoch sharding.
 

@@ -19,7 +19,9 @@ import jax
 
 from k8s_distributed_deeplearning_tpu.parallel import distributed
 from k8s_distributed_deeplearning_tpu.train.checkpoint import Checkpointer
+from k8s_distributed_deeplearning_tpu.train.preemption import PreemptionHandler
 from k8s_distributed_deeplearning_tpu.utils.metrics import MetricsLogger, mfu
+from k8s_distributed_deeplearning_tpu.utils.profiling import StepProfiler
 
 PyTree = Any
 
@@ -37,6 +39,9 @@ def fit(
     global_batch_size: int | None = None,
     flops_per_example: float | None = None,
     peak_flops: float | None = None,
+    preemption: PreemptionHandler | None = None,
+    preemption_sync_every: int = 10,
+    profiler: StepProfiler | None = None,
 ) -> PyTree:
     """Run synchronous training for ``num_steps``; returns the final state.
 
@@ -49,6 +54,14 @@ def fit(
     don't repeat after restore either. Checkpoint writes happen on every
     ``checkpoint_every`` steps and at the end; Orbax coordinates multi-host
     writes, and only the primary logs (``:148-149,:159``).
+
+    *preemption*: a :class:`PreemptionHandler`; when it triggers (SIGTERM from
+    K8s eviction), the loop checkpoints at the step boundary and returns early
+    — the next run resumes from that step. Multi-process jobs reach consensus
+    via ``preemption.agreed()`` every *preemption_sync_every* steps (a host
+    all-gather), so all processes branch identically even when only some pods
+    were signalled; single-process jobs react on the next step. *profiler*: a
+    :class:`~utils.profiling.StepProfiler` tracing a steady-state step window.
     """
     start_step = 0
     if checkpointer is not None:
@@ -64,9 +77,31 @@ def fit(
     step_last = start_step  # steps actually in the current timing window
     step = start_step
     for step in range(start_step, num_steps):
+        if profiler is not None:
+            profiler.step_hook(step)
         batch = next(batch_iter)
         step_rng = jax.random.fold_in(rng, step)
         state, loss, aux = step_fn(state, batch, step_rng)
+
+        if preemption is not None:
+            # Single process: react immediately on the local flag. Multi-
+            # process: ONLY branch on the collective agreement (same step on
+            # every process) — a local-flag branch would diverge the SPMD
+            # programs and deadlock (see preemption.py).
+            if jax.process_count() == 1:
+                stop = preemption.triggered
+            else:
+                stop = ((step + 1) % preemption_sync_every == 0
+                        and preemption.agreed())
+            if stop:
+                if checkpointer is not None:
+                    checkpointer.save(step + 1, state, force=True)
+                if metrics:
+                    metrics.emit("preempted", step=step + 1,
+                                 checkpointed=checkpointer is not None)
+                if profiler is not None:
+                    profiler.stop()
+                return state
 
         if metrics and log_every and (step + 1) % log_every == 0:
             loss_f = float(loss)  # blocks: this is the host sync point
@@ -90,6 +125,8 @@ def fit(
             if metrics:
                 metrics.emit("checkpoint", step=step + 1)
 
+    if profiler is not None:
+        profiler.stop()
     if (checkpointer is not None and num_steps > start_step
             and checkpointer.latest_step() != num_steps):
         checkpointer.save(num_steps, state, force=True)

@@ -1,0 +1,89 @@
+"""Failure detection: graceful preemption -> checkpoint -> resume.
+
+The reference's entire failure story is "MonitoredTrainingSession closes when
+an error occurs" plus the operator's ``cleanPodPolicy: Running``
+(``tensorflow_mnist.py:162-164``, ``tensorflow-mnist.yaml:8``) — a dying rank
+kills the MPI job and loses everything since the last implicit save. On K8s,
+pods get SIGTERM + a grace period before eviction (node drain, spot/preemptible
+TPU reclaim); catching it and checkpointing turns preemption into a clean
+resume via the loop's restore-on-start path (``train/loop.py``).
+
+Usage::
+
+    handler = PreemptionHandler.install()
+    state = fit(..., preemption=handler)   # loop saves + exits when triggered
+
+The handler only *requests* a stop; the training loop performs the (collective,
+all-process) Orbax save at the next agreement boundary. Multi-host correctness:
+a node drain may signal only *some* pods, and a process that branches on its
+local flag while the others dispatch the next train step deadlocks the job
+(both paths are collectives). :meth:`agreed` is the consensus point — every
+process calls it at the same step, the flags are all-gathered, and all
+processes take the same branch; the loop only ever branches on ``agreed()``
+when more than one process is present.
+"""
+from __future__ import annotations
+
+import signal
+import threading
+from typing import Iterable
+
+__all__ = ["PreemptionHandler"]
+
+
+class PreemptionHandler:
+    """Latches termination signals into a thread-safe "stop requested" flag."""
+
+    def __init__(self) -> None:
+        self._event = threading.Event()
+        self._signals: list[int] = []
+        self._prev: dict[int, object] = {}
+
+    @classmethod
+    def install(cls, signals_to_catch: Iterable[int] = (signal.SIGTERM,)
+                ) -> "PreemptionHandler":
+        """Install handlers (main thread only, per the signal module)."""
+        h = cls()
+        for sig in signals_to_catch:
+            h._prev[sig] = signal.signal(sig, h._on_signal)
+            h._signals.append(sig)
+        return h
+
+    def _on_signal(self, signum, frame) -> None:
+        self._event.set()
+
+    def request(self) -> None:
+        """Programmatic trigger (tests; in-process health checks)."""
+        self._event.set()
+
+    @property
+    def triggered(self) -> bool:
+        """This process's local flag. In a multi-process job, do NOT branch
+        collective work on this — use :meth:`agreed`."""
+        return self._event.is_set()
+
+    def agreed(self) -> bool:
+        """Cross-process consensus: True iff ANY process was signalled.
+
+        Collective — every process must call this at the same point (the
+        training loop calls it at a fixed step cadence). Latches the local
+        flag when any peer triggered, so subsequent local reads agree too.
+        """
+        import jax
+
+        if jax.process_count() == 1:
+            return self.triggered
+        import numpy as np
+        from jax.experimental import multihost_utils
+
+        flags = multihost_utils.process_allgather(
+            np.asarray([self.triggered], dtype=np.bool_))
+        if bool(flags.any()):
+            self._event.set()
+            return True
+        return False
+
+    def uninstall(self) -> None:
+        for sig in self._signals:
+            signal.signal(sig, self._prev[sig])
+        self._signals.clear()

@@ -1,0 +1,125 @@
+"""Tracing / profiling — the subsystem the reference lacks entirely.
+
+SURVEY.md §5: the reference's closest thing to profiling is a rank-0
+TensorBoard callback in the undeployed Keras variant
+(``tensorflow_mnist_gpu.py:157-158``); nothing measures step time or device
+activity. Here profiling is first-class and TPU-native:
+
+- :func:`trace` / :class:`StepProfiler` wrap ``jax.profiler`` — the traces
+  land in a TensorBoard/XProf-readable directory with host + device
+  timelines, XLA HLO, and (on TPU) per-op MXU/HBM utilization;
+- :class:`StepTimer` measures honest step wall-times: it blocks on the
+  step's *output value* (TPU dispatch is async; timing the dispatch call
+  alone flatters the number) and reports p50/p95/mean;
+- :func:`annotate` marks host-side spans so data-loading vs dispatch vs
+  blocking time separates cleanly in the trace viewer.
+
+Only the primary process should write traces (rank-0 discipline, parity with
+``tensorflow_mnist.py:159``); pass ``enabled=is_primary()``.
+"""
+from __future__ import annotations
+
+import contextlib
+import statistics
+import time
+from typing import Any, Iterator
+
+import jax
+
+__all__ = ["trace", "annotate", "StepProfiler", "StepTimer"]
+
+
+@contextlib.contextmanager
+def trace(log_dir: str, enabled: bool = True) -> Iterator[None]:
+    """Capture a jax.profiler trace for the enclosed block into *log_dir*
+    (view with TensorBoard's profile plugin / XProf)."""
+    if not enabled:
+        yield
+        return
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str) -> contextlib.AbstractContextManager:
+    """Named host-side span, visible in the trace viewer's host timeline."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+class StepProfiler:
+    """Trace a step window inside a training loop.
+
+    ``step_hook(step)`` starts the trace at the first step >= ``start_step``
+    and stops it after ``num_steps`` — the standard "skip warmup/compile,
+    profile steady state" recipe. The >= (with a run-once latch) matters for
+    resumed runs: a restore past start_step still captures a window instead
+    of silently skipping the user's profile request. Safe when the window
+    never arrives (stop() is idempotent).
+    """
+
+    def __init__(self, log_dir: str, start_step: int, num_steps: int = 5,
+                 enabled: bool = True):
+        self.log_dir = log_dir
+        self.start_step = start_step
+        self.num_steps = num_steps
+        self.enabled = enabled
+        self._active = False
+        self._done = False
+        self._stop_step = start_step + num_steps
+
+    def step_hook(self, step: int) -> None:
+        if not self.enabled or self._done:
+            return
+        if not self._active and step >= self.start_step:
+            jax.profiler.start_trace(self.log_dir)
+            self._active = True
+            self._stop_step = step + self.num_steps
+        elif self._active and step >= self._stop_step:
+            self.stop()
+
+    def stop(self) -> None:
+        if self._active:
+            jax.profiler.stop_trace()
+            self._active = False
+            self._done = True
+
+
+class StepTimer:
+    """Wall-clock step statistics with a true device sync per sample.
+
+    ``observe(value)`` blocks on *value* (e.g. the loss) before reading the
+    clock, so async dispatch can't hide device time. Warmup steps (compile)
+    are excluded from the summary.
+    """
+
+    def __init__(self, warmup: int = 2):
+        self.warmup = warmup
+        self._samples: list[float] = []
+        self._seen = 0
+        self._last = time.perf_counter()
+
+    def observe(self, value: Any = None) -> float:
+        if value is not None:
+            jax.block_until_ready(value)
+        now = time.perf_counter()
+        dt = now - self._last
+        self._last = now
+        self._seen += 1
+        if self._seen > self.warmup:
+            self._samples.append(dt)
+        return dt
+
+    def summary(self) -> dict[str, float]:
+        if not self._samples:
+            return {"steps": 0}
+        s = sorted(self._samples)
+        return {
+            "steps": len(s),
+            "mean_ms": 1e3 * statistics.fmean(s),
+            "p50_ms": 1e3 * s[len(s) // 2],
+            "p95_ms": 1e3 * s[min(len(s) - 1, int(len(s) * 0.95))],
+            "min_ms": 1e3 * s[0],
+            "max_ms": 1e3 * s[-1],
+        }

@@ -33,3 +33,56 @@ def test_latest_wins_and_max_to_keep(tmp_path):
     np.testing.assert_allclose(restored["params"]["w"], np.full((3, 2), 3.0))
     assert ckpt.latest_step() == 3
     ckpt.close()
+
+
+def test_elastic_restore_across_topologies(tmp_path):
+    """A checkpoint written under one mesh restores into a different one —
+    the elastic-resume story (the reference only links to Horovod elastic,
+    ``horovod/README.md:20-22``; here resharding is free because Orbax
+    restores to whatever shardings the new abstract state carries)."""
+    import jax
+    import optax
+    from k8s_distributed_deeplearning_tpu.models import llama
+    from k8s_distributed_deeplearning_tpu.parallel import (
+        mesh as mesh_lib, sharding)
+
+    cfg = llama.config_tiny(dtype=jnp.float32, dim=64, n_layers=2)
+    model = llama.LlamaLM(cfg)
+
+    def loss(p, b, r):
+        return llama.loss_fn(model, p, b, r)
+
+    def make(mesh_spec):
+        tr = sharding.ShardedTrainer(loss, optax.adam(1e-3),
+                                     mesh_lib.make_mesh(mesh_spec))
+        state = tr.init(
+            lambda r: model.init(r, jnp.zeros((1, 8), jnp.int32))["params"],
+            jax.random.key(0))
+        return tr, state
+
+    # Train a step on an 8-way FSDP mesh, checkpoint.
+    tr8, state8 = make({"fsdp": 8})
+    step8 = tr8.make_step(donate=False)
+    tokens = jax.random.randint(jax.random.key(1), (8, 17), 0, cfg.vocab_size)
+    state8, loss8, _ = step8(state8, tr8.shard_batch({"tokens": tokens}),
+                             jax.random.key(0))
+    ck = Checkpointer(str(tmp_path / "ck"))
+    ck.save(1, state8)
+    ck.close()
+
+    # Restore into a 2x2(x2-data) mixed mesh "after the resize".
+    tr4, state4 = make({"data": 2, "fsdp": 2, "tensor": 2})
+    ck2 = Checkpointer(str(tmp_path / "ck"))
+    restored, step = ck2.restore_latest(state4)
+    assert step == 1
+    # Values match the source state; shardings match the NEW topology.
+    a = jax.tree.leaves(jax.tree.map(lambda x: np.asarray(x), restored))
+    b = jax.tree.leaves(jax.tree.map(lambda x: np.asarray(x), state8))
+    for x, y in zip(a, b):
+        np.testing.assert_allclose(x, y, rtol=1e-6)
+    # Training continues on the new mesh from the restored state.
+    step4 = tr4.make_step(donate=False)
+    restored, loss4, _ = step4(restored, tr4.shard_batch({"tokens": tokens}),
+                               jax.random.key(1))
+    assert np.isfinite(float(loss4))
+    ck2.close()

@@ -1,0 +1,60 @@
+"""Profiling: trace capture produces an XProf-readable dir; timers are honest."""
+import glob
+import os
+
+import jax
+import jax.numpy as jnp
+
+from k8s_distributed_deeplearning_tpu.utils import profiling
+
+
+def test_trace_writes_profile_dir(tmp_path):
+    d = str(tmp_path / "trace")
+    with profiling.trace(d):
+        with profiling.annotate("matmul-span"):
+            x = jnp.ones((64, 64))
+            jax.block_until_ready(jnp.dot(x, x))
+    files = glob.glob(os.path.join(d, "**", "*"), recursive=True)
+    assert any("trace" in f or f.endswith(".pb") or f.endswith(".json.gz")
+               for f in files), files
+
+
+def test_trace_disabled_writes_nothing(tmp_path):
+    d = str(tmp_path / "trace")
+    with profiling.trace(d, enabled=False):
+        jax.block_until_ready(jnp.ones((8, 8)) * 2)
+    assert not os.path.exists(d)
+
+
+def test_step_profiler_window(tmp_path):
+    d = str(tmp_path / "prof")
+    p = profiling.StepProfiler(d, start_step=2, num_steps=2)
+    for step in range(6):
+        p.step_hook(step)
+        jax.block_until_ready(jnp.ones((8, 8)) + step)
+    p.stop()   # idempotent
+    assert glob.glob(os.path.join(d, "**", "*"), recursive=True)
+
+
+def test_step_timer_statistics():
+    t = profiling.StepTimer(warmup=1)
+    for i in range(5):
+        t.observe(jnp.ones((4,)) * i)
+    s = t.summary()
+    assert s["steps"] == 4
+    assert 0 < s["p50_ms"] <= s["max_ms"]
+    assert s["min_ms"] <= s["mean_ms"] <= s["max_ms"]
+
+
+def test_step_profiler_starts_on_resumed_run(tmp_path):
+    """A run restored past start_step must still capture a window (>= latch)."""
+    d = str(tmp_path / "prof_resume")
+    p = profiling.StepProfiler(d, start_step=10, num_steps=2)
+    for step in range(100, 105):     # resumed at step 100
+        p.step_hook(step)
+        jax.block_until_ready(jnp.ones((4, 4)) + step)
+    p.stop()
+    assert glob.glob(os.path.join(d, "**", "*"), recursive=True)
+    # Done latch: a later window does not restart the trace.
+    p.step_hook(200)
+    assert not p._active
