@@ -1,0 +1,84 @@
+"""Autoregressive generation with a static-shape KV cache.
+
+The reference trains and (in the Keras variant) saves/evaluates models
+(``tensorflow_mnist_gpu.py:184-191``) but has no inference path at all; a
+complete LM framework needs one. TPU-first design:
+
+- the KV cache is a fixed ``[B, max_seq_len, kv, head_dim]`` buffer per layer
+  (mutable "cache" collection in :mod:`models.transformer`), updated with
+  ``dynamic_update_slice`` — no growing arrays, so the decode step compiles
+  once and reruns for every token;
+- the whole generate loop is ONE jitted program: prefill over the prompt,
+  then ``lax.scan`` over decode steps (token-at-a-time), greedy or
+  temperature sampling inside the scan body;
+- early termination on EOS is a mask carried through the scan (lanes keep
+  running — SPMD-friendly — but finished sequences emit ``pad_id``).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+PyTree = Any
+
+
+@functools.partial(jax.jit, static_argnames=("model", "max_new_tokens",
+                                             "temperature", "eos_id", "pad_id"))
+def generate(model, params: PyTree, prompt: jax.Array, *,
+             max_new_tokens: int, rng: jax.Array | None = None,
+             temperature: float = 0.0, eos_id: int | None = None,
+             pad_id: int = 0) -> jax.Array:
+    """Generate ``max_new_tokens`` continuations of ``prompt`` ([B, S] int32).
+
+    ``temperature=0`` is greedy argmax; otherwise categorical sampling with
+    logits/temperature (requires *rng*). Returns [B, max_new_tokens] int32.
+    Prompt + new tokens must fit the model's ``max_seq_len``.
+    """
+    if temperature > 0.0 and rng is None:
+        raise ValueError("temperature sampling requires rng")
+    if max_new_tokens < 1:
+        raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+    max_seq = getattr(getattr(model, "cfg", None), "max_seq_len", None)
+    if max_seq is not None and prompt.shape[1] + max_new_tokens > max_seq:
+        raise ValueError(
+            f"prompt ({prompt.shape[1]}) + max_new_tokens ({max_new_tokens}) "
+            f"exceeds the model's max_seq_len ({max_seq}) — the KV cache "
+            "would overflow")
+    rng = jax.random.key(0) if rng is None else rng
+
+    # Prefill: run the prompt through decode mode, filling the cache.
+    logits, vars_ = model.apply({"params": params}, prompt, decode=True,
+                                mutable=["cache"])
+    cache = vars_["cache"]
+
+    def sample(logits_last, step_rng):
+        if temperature > 0.0:
+            return jax.random.categorical(step_rng,
+                                          logits_last / temperature, axis=-1)
+        return jnp.argmax(logits_last, axis=-1)
+
+    rng, r0 = jax.random.split(rng)
+    first = sample(logits[:, -1, :], r0).astype(jnp.int32)     # [B]
+    # The first sampled token is emitted as-is; sequences that emitted EOS
+    # are no longer alive and pad from the next step on.
+    alive0 = (first != eos_id if eos_id is not None
+              else jnp.ones_like(first, jnp.bool_))
+
+    def body(carry, step_rng):
+        cache, token, alive = carry
+        logits, vars_ = model.apply({"params": params, "cache": cache},
+                                    token[:, None], decode=True,
+                                    mutable=["cache"])
+        nxt = sample(logits[:, -1, :], step_rng).astype(jnp.int32)
+        if eos_id is not None:
+            nxt = jnp.where(alive, nxt, pad_id)
+            alive = alive & (nxt != eos_id)
+        return (vars_["cache"], nxt, alive), nxt
+
+    steps = jax.random.split(rng, max(max_new_tokens - 1, 0))
+    (_, _, _), rest = jax.lax.scan(body, (cache, first, alive0), steps)
+    out = jnp.concatenate([first[:, None], rest.T], axis=1)
+    return out

@@ -33,10 +33,11 @@ class LlamaLM(nn.Module):
     def __call__(self, tokens: jax.Array, *,
                  positions: jax.Array | None = None,
                  deterministic: bool = True,
-                 attention_fn=None) -> jax.Array:
+                 attention_fn=None,
+                 decode: bool = False) -> jax.Array:
         x = Transformer(self.cfg, name="transformer")(
             tokens, positions=positions, deterministic=deterministic,
-            attention_fn=attention_fn)
+            attention_fn=attention_fn, decode=decode)
         embedding = None
         if self.cfg.tie_embeddings:
             embedding = self.variables["params"]["transformer"]["tok_embed"]["embedding"]

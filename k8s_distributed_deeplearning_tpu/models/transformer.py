@@ -151,6 +151,12 @@ class Attention(nn.Module):
     rule mapping "heads"/"kv" to the tensor axis shards the heads dimension
     (Megatron-style column parallel); the output projection is
     [heads, head_dim, embed] (row parallel — XLA inserts the psum).
+
+    ``decode=True`` switches to KV-cache autoregressive mode: cached K/V
+    ([B, max_seq_len, kv, head_dim], static shapes — XLA-friendly
+    ``dynamic_update_slice``, never a growing array) live in the mutable
+    "cache" collection; each call appends the current chunk and attends the
+    chunk's queries against the cache prefix.
     """
 
     cfg: TransformerConfig
@@ -159,7 +165,8 @@ class Attention(nn.Module):
     def __call__(self, x: jax.Array, *,
                  mask: jax.Array | None = None,
                  positions: jax.Array | None = None,
-                 attention_fn: Callable | None = None) -> jax.Array:
+                 attention_fn: Callable | None = None,
+                 decode: bool = False) -> jax.Array:
         cfg = self.cfg
         hd = cfg.resolved_head_dim
         q = nn.DenseGeneral((cfg.n_heads, hd), axis=-1, use_bias=False,
@@ -177,19 +184,56 @@ class Attention(nn.Module):
                             kernel_init=nn.with_logical_partitioning(
                                 default_init(), ("embed", "kv", "head_dim")),
                             name="v_proj")(x)
+        cur = None
+        if decode:
+            if mask is not None or attention_fn is not None:
+                raise NotImplementedError(
+                    "decode mode builds its own cache-prefix mask and local "
+                    "attention; caller-provided mask/attention_fn would be "
+                    "silently wrong — left-pad-free prompts only for now")
+            b, sq = x.shape[0], x.shape[1]
+            kv = cfg.resolved_kv_heads
+            cached_k = self.variable("cache", "cached_key", jnp.zeros,
+                                     (b, cfg.max_seq_len, kv, hd), cfg.dtype)
+            cached_v = self.variable("cache", "cached_value", jnp.zeros,
+                                     (b, cfg.max_seq_len, kv, hd), cfg.dtype)
+            cache_index = self.variable("cache", "cache_index",
+                                        lambda: jnp.zeros((), jnp.int32))
+            cur = cache_index.value
+            if positions is None:
+                # Absolute positions for RoPE: the cache cursor onward.
+                positions = (cur + jnp.arange(sq))[None, :]
+
         if cfg.position == "rope":
             cos, sin = rope_frequencies(hd, cfg.max_seq_len, cfg.rope_theta)
             q = apply_rope(q, cos, sin, positions)
             k = apply_rope(k, cos, sin, positions)
-        q = nn.with_logical_constraint(q, ("batch", "seq", "heads", "head_dim"))
-        k = nn.with_logical_constraint(k, ("batch", "seq", "kv", "head_dim"))
-        v = nn.with_logical_constraint(v, ("batch", "seq", "kv", "head_dim"))
 
-        if attention_fn is not None:
-            out = attention_fn(q, k, v, causal=cfg.causal, mask=mask)
-        else:
+        if decode:
+            # Append this chunk at the cursor (static-shape cache update) and
+            # attend the chunk's queries against the cache prefix: query at
+            # absolute position cur+i sees columns <= cur+i.
+            k_all = jax.lax.dynamic_update_slice(
+                cached_k.value, k.astype(cached_k.value.dtype), (0, cur, 0, 0))
+            v_all = jax.lax.dynamic_update_slice(
+                cached_v.value, v.astype(cached_v.value.dtype), (0, cur, 0, 0))
+            cached_k.value, cached_v.value = k_all, v_all
+            cache_index.value = cur + sq
+            col = jnp.arange(cfg.max_seq_len)
+            row_pos = cur + jnp.arange(sq)
+            dmask = (col[None, :] <= row_pos[:, None])[None, None]  # [1,1,sq,Smax]
             out = attention_ops.multi_head_attention(
-                q, k, v, causal=cfg.causal, mask=mask, impl=cfg.attention_impl)
+                q, k_all, v_all, causal=False, mask=dmask, impl="xla")
+        else:
+            q = nn.with_logical_constraint(q, ("batch", "seq", "heads", "head_dim"))
+            k = nn.with_logical_constraint(k, ("batch", "seq", "kv", "head_dim"))
+            v = nn.with_logical_constraint(v, ("batch", "seq", "kv", "head_dim"))
+            if attention_fn is not None:
+                out = attention_fn(q, k, v, causal=cfg.causal, mask=mask)
+            else:
+                out = attention_ops.multi_head_attention(
+                    q, k, v, causal=cfg.causal, mask=mask,
+                    impl=cfg.attention_impl)
         out = nn.with_logical_constraint(out, ("batch", "seq", "heads", "head_dim"))
         out = nn.DenseGeneral(cfg.dim, axis=(-2, -1), use_bias=False,
                               dtype=cfg.dtype, param_dtype=jnp.float32,
@@ -239,11 +283,13 @@ class Block(nn.Module):
                  mask: jax.Array | None = None,
                  positions: jax.Array | None = None,
                  deterministic: bool = True,
-                 attention_fn: Callable | None = None) -> jax.Array:
+                 attention_fn: Callable | None = None,
+                 decode: bool = False) -> jax.Array:
         cfg = self.cfg
         h = make_norm(cfg, "attn_norm")(x)
         h = Attention(cfg, name="attn")(h, mask=mask, positions=positions,
-                                        attention_fn=attention_fn)
+                                        attention_fn=attention_fn,
+                                        decode=decode)
         if cfg.dropout_rate:
             h = nn.Dropout(cfg.dropout_rate, deterministic=deterministic)(h)
         x = x + h
@@ -272,7 +318,8 @@ class Transformer(nn.Module):
                  mask: jax.Array | None = None,
                  positions: jax.Array | None = None,
                  deterministic: bool = True,
-                 attention_fn: Callable | None = None) -> jax.Array:
+                 attention_fn: Callable | None = None,
+                 decode: bool = False) -> jax.Array:
         cfg = self.cfg
         if tokens_or_embeds.dtype in (jnp.int32, jnp.int64):
             x = nn.Embed(cfg.vocab_size, cfg.dim, dtype=cfg.dtype,
@@ -283,6 +330,13 @@ class Transformer(nn.Module):
         else:
             x = tokens_or_embeds.astype(cfg.dtype)
         if cfg.position == "learned":
+            if decode and positions is None:
+                # The cache cursor lives inside Attention; learned positions
+                # would need it at embed time. RoPE models (the causal-LM
+                # families) are unaffected.
+                raise NotImplementedError(
+                    "decode with position='learned' requires explicit "
+                    "positions — pass positions=cache_cursor + arange(S)")
             pos = positions if positions is not None else jnp.arange(x.shape[1])
             x = x + nn.Embed(cfg.max_seq_len, cfg.dim, dtype=cfg.dtype,
                              param_dtype=jnp.float32,
@@ -292,18 +346,24 @@ class Transformer(nn.Module):
         x = nn.with_logical_constraint(x, ("batch", "seq", "embed"))
 
         block_cls = Block
-        if cfg.remat:
+        if cfg.remat and not decode:
+            # remat trades FLOPs for backward-pass memory; decode has no
+            # backward pass, and remat + mutable cache writes don't mix.
             block_cls = nn.remat(
                 Block, prevent_cse=False,
                 static_argnums=(),
                 policy=jax.checkpoint_policies.nothing_saveable)
+        # Pass decode only when set: under nn.remat every call argument is
+        # traced, which would turn the static `decode` python bool into a
+        # tracer (remat is never combined with decode — guarded above).
+        dkw = {"decode": True} if decode else {}
         if cfg.scan_layers:
             x, _ = nn.scan(
                 lambda mdl, carry, _: (
                     mdl(carry, mask=mask, positions=positions,
                         deterministic=deterministic,
-                        attention_fn=attention_fn), None),
-                variable_axes={"params": 0, "intermediates": 0},
+                        attention_fn=attention_fn, **dkw), None),
+                variable_axes={"params": 0, "intermediates": 0, "cache": 0},
                 split_rngs={"params": True, "dropout": True},
                 length=cfg.n_layers,
                 metadata_params={nn.PARTITION_NAME: "layers"},
@@ -314,7 +374,8 @@ class Transformer(nn.Module):
                 x = block_cls(cfg, mlp_factory=self.mlp_factory,
                               name=f"block_{i}")(
                     x, mask=mask, positions=positions,
-                    deterministic=deterministic, attention_fn=attention_fn)
+                    deterministic=deterministic, attention_fn=attention_fn,
+                    **dkw)
         return make_norm(cfg, "final_norm")(x)
 
 

@@ -82,3 +82,25 @@ def test_train_llama_resume(tmp_path):
     result = train_llama.main(["--preset", "tiny", "--num-steps", "16"]
                               + base[4:])
     assert result["num_steps"] == 16          # resumed from 10, ran 6 more
+
+
+@pytest.mark.slow
+def test_generate_from_training_checkpoint(tmp_path):
+    import generate_llama
+    import train_llama
+    train_llama.main([
+        "--preset", "tiny", "--num-steps", "8", "--batch-size", "8",
+        "--seq-len", "128", "--no-eval",
+        "--checkpoint-dir", str(tmp_path / "ck"), "--checkpoint-every", "1000"])
+    result = generate_llama.main([
+        "--preset", "tiny", "--checkpoint-dir", str(tmp_path / "ck"),
+        "--max-new-tokens", "16", "--temperature", "0.5"])
+    assert result["step"] == 8
+    assert len(result["tokens"]) == 16
+
+
+def test_generate_missing_checkpoint_errors(tmp_path):
+    import generate_llama
+    with pytest.raises(FileNotFoundError, match="no checkpoint"):
+        generate_llama.main(["--preset", "tiny",
+                             "--checkpoint-dir", str(tmp_path / "none")])
