@@ -18,11 +18,9 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax
 import jax.numpy as jnp
 import numpy as np
-import optax
 
 from k8s_distributed_deeplearning_tpu.models import generate as gen_lib
 from k8s_distributed_deeplearning_tpu.models import llama
-from k8s_distributed_deeplearning_tpu.parallel import sharding
 from k8s_distributed_deeplearning_tpu.train import Checkpointer
 
 from train_llama import PRESETS, build_config
@@ -41,35 +39,24 @@ def main(argv: list[str] | None = None) -> dict:
     ap.add_argument("--seq-len", type=int, default=None)
     ap.add_argument("--dtype", default="float32", choices=["float32", "bfloat16"])
     ap.add_argument("--remat", action="store_true")
-    ap.add_argument("--attention", default="xla")   # build_config compat
     args = ap.parse_args(argv)
+    # Decode always uses the XLA attention path against the KV cache; the
+    # training-time attention impl is irrelevant here (build_config compat).
+    args.attention = "xla"
 
     cfg = build_config(args)
     model = llama.LlamaLM(cfg)
 
-    # Rebuild the training-state TREE SHAPE only (eval_shape: zero device
-    # memory) so the checkpoint structure matches; restore materializes the
-    # arrays straight from disk — no jitted init, no optimizer-moment
-    # allocation beyond the restore itself.
-    optimizer = optax.adamw(1e-4, weight_decay=0.1)
-
-    def make_state(r):
-        params = model.init(r, jnp.zeros((1, 8), jnp.int32))["params"]
-        from k8s_distributed_deeplearning_tpu.parallel.data_parallel import (
-            TrainState)
-        return TrainState(params=params, opt_state=optimizer.init(params),
-                          step=jnp.zeros((), jnp.int32))
-
-    abstract = jax.eval_shape(make_state, jax.random.key(args.seed))
+    # Params-only restore: tree shape comes from checkpoint metadata,
+    # optimizer moments are skipped entirely (ocp.PLACEHOLDER) — no skeleton,
+    # no knowledge of the training run's optimizer, no moment memory.
     ck = Checkpointer(args.checkpoint_dir)
-    restored = ck.restore_latest(abstract)
+    restored = ck.restore_params()
     if restored is None:
         raise FileNotFoundError(
             f"no checkpoint under {args.checkpoint_dir!r} — run "
             "train_llama.py first")
-    state, step = restored
-    params = sharding.unbox(state.params)
-    del state  # free the restored optimizer moments before decode
+    params, step = restored
 
     if args.prompt:
         prompt = jnp.asarray([[b % cfg.vocab_size

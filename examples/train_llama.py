@@ -43,6 +43,7 @@ from k8s_distributed_deeplearning_tpu.train import (
     Checkpointer,
     data as data_lib,
     loop,
+    optim,
 )
 from k8s_distributed_deeplearning_tpu.train.preemption import PreemptionHandler
 from k8s_distributed_deeplearning_tpu.utils.metrics import MetricsLogger
@@ -94,6 +95,11 @@ def main(argv: list[str] | None = None) -> dict:
                         help="checkpoint each block (long-context memory lever)")
     parser.add_argument("--data-path", type=str, default=None,
                         help="byte-level corpus file; default synthetic tokens")
+    parser.add_argument("--optimizer", choices=optim.OPTIMIZERS,
+                        default="adamw")
+    parser.add_argument("--schedule", choices=optim.SCHEDULES,
+                        default="constant")
+    parser.add_argument("--warmup-steps", type=int, default=0)
     parser.add_argument("--profile-dir", type=str, default=None,
                         help="capture a jax.profiler trace of steps 10..15")
     args = parser.parse_args(argv)
@@ -102,13 +108,12 @@ def main(argv: list[str] | None = None) -> dict:
     distributed.initialize_from_env()
     topo = mesh_lib.topology()
     use_cp = args.sp > 1 or args.attention in ("ring", "ulysses")
-    axes = {"data": args.dp, "fsdp": args.fsdp, "tensor": args.tp,
-            "sequence": args.sp}
-    # Keep size-1 axes out of the mesh — except "sequence" when context-
-    # parallel attention is requested, whose shard_map specs name that axis.
-    mesh = mesh_lib.make_mesh({
-        k: v for k, v in axes.items()
-        if v != 1 or k == "data" or (k == "sequence" and use_cp)})
+    # Context-parallel shard_map specs name the "sequence" axis, so keep it
+    # in the mesh even at size 1 when CP attention is requested.
+    mesh = mesh_lib.make_mesh(cfg.MeshConfig(
+        data=args.dp, fsdp=args.fsdp, tensor=args.tp,
+        sequence=args.sp).to_axis_sizes(
+            keep=("sequence",) if use_cp else ()))
 
     model_cfg = build_config(args)
     seq_len = args.seq_len or min(model_cfg.max_seq_len, 512)
@@ -134,7 +139,10 @@ def main(argv: list[str] | None = None) -> dict:
     # reference's steps//world rule, tensorflow_mnist.py:146, presumes a fixed
     # total-sample budget — for LM runs the step budget is the contract).
     num_steps = conf.num_steps
-    optimizer = optax.adamw(conf.lr, weight_decay=0.1)
+    optimizer = optim.make_optimizer(
+        args.optimizer,
+        optim.make_schedule(args.schedule, conf.lr, num_steps,
+                            args.warmup_steps))
     trainer = sharding.ShardedTrainer(loss, optimizer, mesh)
     init = lambda r: model.init(r, jnp.zeros((1, 8), jnp.int32))["params"]
     state = trainer.init(init, jax.random.key(conf.seed))

@@ -73,11 +73,15 @@ class MeshConfig:
     def axis_names(self) -> tuple[str, ...]:
         return ("data", "fsdp", "tensor", "sequence", "expert", "pipeline")
 
-    def to_axis_sizes(self) -> dict[str, int]:
+    def to_axis_sizes(self, keep: tuple[str, ...] = ()) -> dict[str, int]:
         """Axis-size mapping for ``parallel.mesh.make_mesh`` — size-1 axes are
-        dropped (they'd only pad the mesh shape), ``data`` always kept."""
+        dropped (they'd only pad the mesh shape), ``data`` always kept.
+        *keep* forces named axes in even at size 1 (e.g. ``("sequence",)``
+        when context-parallel attention will reference that axis in
+        shard_map specs)."""
         sizes = {name: getattr(self, name) for name in self.axis_names()}
-        return {k: v for k, v in sizes.items() if v != 1 or k == "data"}
+        return {k: v for k, v in sizes.items()
+                if v != 1 or k == "data" or k in keep}
 
 
 @dataclass

@@ -25,8 +25,6 @@ import jax.numpy as jnp
 PyTree = Any
 
 
-@functools.partial(jax.jit, static_argnames=("model", "max_new_tokens",
-                                             "temperature", "eos_id", "pad_id"))
 def generate(model, params: PyTree, prompt: jax.Array, *,
              max_new_tokens: int, rng: jax.Array | None = None,
              temperature: float = 0.0, eos_id: int | None = None,
@@ -35,7 +33,9 @@ def generate(model, params: PyTree, prompt: jax.Array, *,
 
     ``temperature=0`` is greedy argmax; otherwise categorical sampling with
     logits/temperature (requires *rng*). Returns [B, max_new_tokens] int32.
-    Prompt + new tokens must fit the model's ``max_seq_len``.
+    Prompt + new tokens must fit the model's ``max_seq_len``. Only the
+    greedy/sampling CHOICE is compile-time; the temperature value itself is a
+    traced operand, so sweeping temperatures reuses one compiled program.
     """
     if temperature > 0.0 and rng is None:
         raise ValueError("temperature sampling requires rng")
@@ -48,14 +48,26 @@ def generate(model, params: PyTree, prompt: jax.Array, *,
             f"exceeds the model's max_seq_len ({max_seq}) — the KV cache "
             "would overflow")
     rng = jax.random.key(0) if rng is None else rng
+    return _generate(model, params, prompt, jnp.float32(temperature), rng,
+                     greedy=temperature <= 0.0,
+                     max_new_tokens=max_new_tokens, eos_id=eos_id,
+                     pad_id=pad_id)
 
+
+@functools.partial(jax.jit, static_argnames=("model", "greedy",
+                                             "max_new_tokens", "eos_id",
+                                             "pad_id"))
+def _generate(model, params: PyTree, prompt: jax.Array,
+              temperature: jax.Array, rng: jax.Array, *, greedy: bool,
+              max_new_tokens: int, eos_id: int | None,
+              pad_id: int) -> jax.Array:
     # Prefill: run the prompt through decode mode, filling the cache.
     logits, vars_ = model.apply({"params": params}, prompt, decode=True,
                                 mutable=["cache"])
     cache = vars_["cache"]
 
     def sample(logits_last, step_rng):
-        if temperature > 0.0:
+        if not greedy:
             return jax.random.categorical(step_rng,
                                           logits_last / temperature, axis=-1)
         return jnp.argmax(logits_last, axis=-1)

@@ -33,6 +33,9 @@ class Checkpointer:
             self.directory,
             options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep,
                                                  create=True),
+            # Explicit handler so a fresh manager can read item_metadata of an
+            # existing checkpoint (restore_params) without a prior save.
+            item_handlers=ocp.StandardCheckpointHandler(),
         )
 
     def save(self, step: int, state: PyTree, force: bool = False) -> bool:
@@ -61,6 +64,61 @@ class Checkpointer:
             abstract_state)
         state = self._mgr.restore(step, args=ocp.args.StandardRestore(ref))
         return state, step
+
+    def restore_params(self, key: str = "params",
+                       sharding: "jax.sharding.Sharding | None" = None
+                       ) -> tuple[PyTree, int] | None:
+        """Restore ONLY the *key* subtree of the newest checkpoint (inference
+        path): every other leaf is an ``ocp.PLACEHOLDER``, so optimizer
+        moments are never read or materialized, and the caller needs no
+        knowledge of which optimizer the training run used. The tree shape
+        comes from the checkpoint's own metadata — no model/optimizer
+        skeleton required.
+
+        *sharding* places the restored arrays on the CURRENT topology
+        (default: replicated across this process's devices). Never restores
+        with save-time shardings, so a checkpoint written on an N-chip mesh
+        loads on a different machine shape (Orbax's "populate sharding from
+        file" path is explicitly avoided — it references save-time devices).
+        """
+        import os
+
+        step = self._mgr.latest_step()
+        if step is None:
+            return None
+        if sharding is None:
+            from jax.sharding import Mesh, NamedSharding, PartitionSpec
+            sharding = NamedSharding(
+                Mesh(jax.devices(), ("_restore",)), PartitionSpec())
+        path = os.path.join(self.directory, str(step), "default")
+        ckptr = ocp.PyTreeCheckpointer()
+        meta = ckptr.metadata(path).item_metadata
+        tree = meta.tree if hasattr(meta, "tree") else meta
+
+        def to_abstract(p, m):
+            in_key = any(
+                getattr(x, "key", getattr(x, "name", None)) == key for x in p)
+            if not in_key:
+                return ocp.PLACEHOLDER
+            return jax.ShapeDtypeStruct(m.shape, m.dtype, sharding=sharding)
+
+        abstract = jax.tree_util.tree_map_with_path(to_abstract, tree)
+        restored = ckptr.restore(path, args=ocp.args.PyTreeRestore(
+            item=abstract))
+
+        def collapse(node):
+            # flax Partitioned boxes serialize as a {'value': ...} dict level;
+            # strip them so callers get plain param arrays (unboxed form).
+            if isinstance(node, dict):
+                if set(node) == {"value"}:
+                    return collapse(node["value"])
+                return {k: collapse(v) for k, v in node.items()}
+            return node
+
+        # Orbax versions differ on honoring ShapeDtypeStruct.sharding in
+        # PyTreeRestore; device_put enforces the documented current-topology
+        # placement regardless.
+        return jax.device_put(collapse(restored[key]), sharding), step
 
     def close(self) -> None:
         self._mgr.close()

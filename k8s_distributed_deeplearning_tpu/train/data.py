@@ -46,22 +46,33 @@ def load_mnist(data_dir: str, split: str = "train") -> tuple[np.ndarray, np.ndar
     return images.astype(np.float32)[..., None] / 255.0, labels.astype(np.int32)
 
 
-def synthetic_mnist(num: int = 4096, seed: int = 0, noise: float = 0.25,
-                    sample_seed: int | None = None) -> tuple[np.ndarray, np.ndarray]:
-    """Procedural MNIST stand-in for zero-egress environments.
-
-    Ten fixed random 28×28 class templates + per-example Gaussian noise —
-    linearly separable enough that the parity ConvNet trains to high accuracy
-    fast, which is what tests and smoke runs need. ``seed`` fixes the class
-    templates (the "dataset"); ``sample_seed`` varies the drawn examples, so
-    train/test splits share templates but not samples.
+def synthetic_images(num: int, *, size: int = 32, channels: int = 3,
+                     num_classes: int = 10, seed: int = 0,
+                     noise: float = 0.25,
+                     sample_seed: int | None = None
+                     ) -> tuple[np.ndarray, np.ndarray]:
+    """Procedural image-classification set for zero-egress environments:
+    fixed random class templates + per-example Gaussian noise. ``seed`` fixes
+    the templates (the "dataset"); ``sample_seed`` varies the drawn examples,
+    so train/test splits share templates but not samples.
     """
     tmpl_rng = np.random.default_rng(seed)
-    templates = tmpl_rng.normal(size=(10, 28, 28, 1)).astype(np.float32)
+    templates = tmpl_rng.normal(
+        size=(num_classes, size, size, channels)).astype(np.float32)
     rng = np.random.default_rng(seed if sample_seed is None else sample_seed)
-    labels = rng.integers(0, 10, size=(num,)).astype(np.int32)
-    images = templates[labels] + noise * rng.normal(size=(num, 28, 28, 1)).astype(np.float32)
+    labels = rng.integers(0, num_classes, size=(num,)).astype(np.int32)
+    images = templates[labels] + noise * rng.normal(
+        size=(num, size, size, channels)).astype(np.float32)
     return images.astype(np.float32), labels
+
+
+def synthetic_mnist(num: int = 4096, seed: int = 0, noise: float = 0.25,
+                    sample_seed: int | None = None) -> tuple[np.ndarray, np.ndarray]:
+    """MNIST-shaped instance of :func:`synthetic_images` (28×28×1, 10
+    classes) — the parity ConvNet trains to high accuracy fast on it, which
+    is what tests and smoke runs need."""
+    return synthetic_images(num, size=28, channels=1, num_classes=10,
+                            seed=seed, noise=noise, sample_seed=sample_seed)
 
 
 def load_or_synthesize(data_dir: str | None, split: str = "train",

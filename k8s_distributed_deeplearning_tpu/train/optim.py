@@ -1,0 +1,55 @@
+"""Optimizer + LR-schedule factory.
+
+The reference hard-codes ``AdamOptimizer(lr * world)`` (``tensorflow_mnist.py
+:123-130``); real pretraining runs need warmup + decay. One factory serves
+every training script so schedules are flags, not code forks.
+"""
+from __future__ import annotations
+
+import optax
+
+SCHEDULES = ("constant", "cosine", "linear")
+OPTIMIZERS = ("adam", "adamw", "sgd")
+
+
+def make_schedule(name: str, lr: float, total_steps: int,
+                  warmup_steps: int = 0) -> optax.Schedule | float:
+    """LR schedule: linear warmup to *lr*, then constant / cosine / linear
+    decay over the remaining budget."""
+    if name not in SCHEDULES:
+        raise ValueError(f"schedule {name!r} not in {SCHEDULES}")
+    if name == "constant" and not warmup_steps:
+        return lr
+    decay = max(total_steps - warmup_steps, 1)
+    if name == "cosine":
+        return optax.warmup_cosine_decay_schedule(
+            init_value=0.0, peak_value=lr, warmup_steps=warmup_steps,
+            decay_steps=max(total_steps, warmup_steps + 1), end_value=0.1 * lr)
+    if name == "linear":
+        return optax.join_schedules(
+            [optax.linear_schedule(0.0, lr, max(warmup_steps, 1)),
+             optax.linear_schedule(lr, 0.0, decay)],
+            boundaries=[warmup_steps])
+    # constant with warmup
+    return optax.join_schedules(
+        [optax.linear_schedule(0.0, lr, max(warmup_steps, 1)),
+         optax.constant_schedule(lr)],
+        boundaries=[warmup_steps])
+
+
+def make_optimizer(name: str, lr, *, weight_decay: float = 0.1,
+                   grad_clip: float | None = 1.0,
+                   momentum: float = 0.9) -> optax.GradientTransformation:
+    """Optimizer with optional global-norm clipping (standard LM hygiene the
+    reference lacks). *lr* may be a float or a schedule."""
+    if name == "adam":
+        tx = optax.adam(lr)
+    elif name == "adamw":
+        tx = optax.adamw(lr, weight_decay=weight_decay)
+    elif name == "sgd":
+        tx = optax.sgd(lr, momentum=momentum, nesterov=True)
+    else:
+        raise ValueError(f"optimizer {name!r} not in {OPTIMIZERS}")
+    if grad_clip:
+        return optax.chain(optax.clip_by_global_norm(grad_clip), tx)
+    return tx

@@ -86,3 +86,38 @@ def test_elastic_restore_across_topologies(tmp_path):
                                jax.random.key(1))
     assert np.isfinite(float(loss4))
     ck2.close()
+
+
+def test_restore_params_skips_optimizer_state(tmp_path):
+    """Params-only restore reads the tree shape from checkpoint metadata and
+    never materializes optimizer moments (inference path)."""
+    import optax
+    from k8s_distributed_deeplearning_tpu.parallel.data_parallel import (
+        TrainState)
+
+    params = {"w": jnp.full((4, 4), 2.5), "b": jnp.zeros((4,))}
+    tx = optax.chain(optax.clip_by_global_norm(1.0), optax.adamw(1e-3))
+    st = TrainState(params, tx.init(params), jnp.asarray(0))
+    ck = Checkpointer(str(tmp_path / "ck"))
+    ck.save(5, st)
+    ck.close()
+
+    # Fresh manager, no knowledge of the optimizer used at save time.
+    ck2 = Checkpointer(str(tmp_path / "ck"))
+    restored, step = ck2.restore_params()
+    assert step == 5
+    np.testing.assert_allclose(np.asarray(restored["w"]), 2.5)
+    np.testing.assert_allclose(np.asarray(restored["b"]), 0.0)
+    # Arrays land on the CURRENT topology (replicated over this process's
+    # devices), never with save-time shardings read from the file.
+    import jax
+    sh = restored["w"].sharding
+    assert sh.is_fully_replicated
+    assert set(sh.device_set) == set(jax.devices())
+    ck2.close()
+
+
+def test_restore_params_empty_returns_none(tmp_path):
+    ck = Checkpointer(str(tmp_path / "nothing"))
+    assert ck.restore_params() is None
+    ck.close()

@@ -112,11 +112,7 @@ def test_decode_rejects_mask_and_learned_positions():
 
     from k8s_distributed_deeplearning_tpu.models import bert
     bcfg = bert.config_tiny()                      # position="learned"
-    bmodel = bert.BertMLM(bcfg)
     btoks = jax.random.randint(jax.random.key(0), (1, 8), 0, bcfg.vocab_size)
-    bparams = bmodel.init(jax.random.key(1), btoks)["params"]
-    # BertMLM has no decode kwarg; exercise the Transformer guard directly.
-    from k8s_distributed_deeplearning_tpu.models import transformer as tfm
     enc = tfm.Transformer(bcfg)
     eparams = enc.init(jax.random.key(2), btoks)["params"]
     with pytest.raises(NotImplementedError, match="learned"):
