@@ -44,6 +44,7 @@ from k8s_distributed_deeplearning_tpu.train import (
     data as data_lib,
     loop,
     optim,
+    prefetch,
 )
 from k8s_distributed_deeplearning_tpu.train.preemption import PreemptionHandler
 from k8s_distributed_deeplearning_tpu.utils.metrics import MetricsLogger
@@ -100,8 +101,12 @@ def main(argv: list[str] | None = None) -> dict:
     parser.add_argument("--schedule", choices=optim.SCHEDULES,
                         default="constant")
     parser.add_argument("--warmup-steps", type=int, default=0)
+    parser.add_argument("--grad-clip", type=float, default=1.0,
+                        help="global-norm gradient clip (0 disables)")
     parser.add_argument("--profile-dir", type=str, default=None,
                         help="capture a jax.profiler trace of steps 10..15")
+    parser.add_argument("--prefetch", type=int, default=2,
+                        help="batches staged ahead by a host thread (0 = off)")
     args = parser.parse_args(argv)
     conf = cfg.train_config_from_args(args)
 
@@ -142,7 +147,8 @@ def main(argv: list[str] | None = None) -> dict:
     optimizer = optim.make_optimizer(
         args.optimizer,
         optim.make_schedule(args.schedule, conf.lr, num_steps,
-                            args.warmup_steps))
+                            args.warmup_steps),
+        grad_clip=args.grad_clip or None)
     trainer = sharding.ShardedTrainer(loss, optimizer, mesh)
     init = lambda r: model.init(r, jnp.zeros((1, 8), jnp.int32))["params"]
     state = trainer.init(init, jax.random.key(conf.seed))
@@ -180,50 +186,58 @@ def main(argv: list[str] | None = None) -> dict:
                        zip(mesh.axis_names, mesh.devices.shape)},
                  attention=args.attention, platform=topo.platform)
 
+    prefetchers: list = []
+
     def global_batches(start_step: int):
-        return (trainer.shard_batch(b) for b in batcher.iter_from(start_step))
+        return prefetch.maybe(batcher.iter_from(start_step),
+                              trainer.shard_batch, args.prefetch, prefetchers)
 
     flops_per_example = llama.flops_per_token(model_cfg) * seq_len
-    state = loop.fit(
-        step_fn, state, global_batches, num_steps, jax.random.key(conf.seed),
-        metrics=metrics, checkpointer=ckpt,
-        checkpoint_every=conf.checkpoint_every, log_every=conf.log_every,
-        global_batch_size=global_batch,
-        flops_per_example=flops_per_example,
-        peak_flops=mesh_lib.peak_flops_per_device(args.dtype),
-        preemption=preemption, profiler=profiler,
-    )
+    try:
+        state = loop.fit(
+            step_fn, state, global_batches, num_steps,
+            jax.random.key(conf.seed),
+            metrics=metrics, checkpointer=ckpt,
+            checkpoint_every=conf.checkpoint_every, log_every=conf.log_every,
+            global_batch_size=global_batch,
+            flops_per_example=flops_per_example,
+            peak_flops=mesh_lib.peak_flops_per_device(args.dtype),
+            preemption=preemption, profiler=profiler,
+        )
 
-    result: dict = {"num_steps": int(jax.device_get(state.step)),
-                    "world_size": topo.world_size, "params": int(n_params)}
-    # Skip eval when preempted: the grace period is for checkpointing, and an
-    # "eval" event would make an evicted run look like a completed one.
-    if conf.eval_final and not preemption.triggered:
-        # Held-out perplexity on the reserved corpus tail, sharded across
-        # processes like training data.
-        windows_per_proc = ((len(eval_tokens) - 1) // seq_len
-                            ) // topo.num_processes
-        if windows_per_proc < 1:
-            metrics.emit("eval_skipped", reason="held-out set smaller than "
-                         "one window per process")
-        else:
-            eval_batcher = data_lib.TokenBatcher(
-                eval_tokens, min(per_host, windows_per_proc), seq_len,
-                seed=conf.seed, process_index=topo.process_index,
-                num_processes=topo.num_processes)
-            eval_step = jax.jit(lambda p, b: loss(p, b, None)[0])
-            n_batches = min(4, eval_batcher.batches_per_epoch)
-            eval_losses = [
-                float(eval_step(state.params,
-                                trainer.shard_batch(eval_batcher.batch_at(s))))
-                for s in range(n_batches)]
-            import math
-            ev = sum(eval_losses) / len(eval_losses)
-            metrics.emit("eval", loss=ev, perplexity=math.exp(ev))
-            result["eval_loss"] = ev
-    preemption.uninstall()
-    ckpt.close()
-    metrics.close()
+        result: dict = {"num_steps": int(jax.device_get(state.step)),
+                        "world_size": topo.world_size, "params": int(n_params)}
+        # Skip eval when preempted: the grace period is for checkpointing,
+        # and an "eval" event would make an evicted run look completed.
+        if conf.eval_final and not preemption.triggered:
+            # Held-out perplexity on the reserved corpus tail, sharded across
+            # processes like training data.
+            windows_per_proc = ((len(eval_tokens) - 1) // seq_len
+                                ) // topo.num_processes
+            if windows_per_proc < 1:
+                metrics.emit("eval_skipped",
+                             reason="held-out set smaller than one window "
+                             "per process")
+            else:
+                eval_batcher = data_lib.TokenBatcher(
+                    eval_tokens, min(per_host, windows_per_proc), seq_len,
+                    seed=conf.seed, process_index=topo.process_index,
+                    num_processes=topo.num_processes)
+                eval_step = jax.jit(lambda p, b: loss(p, b, None)[0])
+                n_batches = min(4, eval_batcher.batches_per_epoch)
+                eval_losses = [
+                    float(eval_step(state.params, trainer.shard_batch(
+                        eval_batcher.batch_at(s))))
+                    for s in range(n_batches)]
+                import math
+                ev = sum(eval_losses) / len(eval_losses)
+                metrics.emit("eval", loss=ev, perplexity=math.exp(ev))
+                result["eval_loss"] = ev
+    finally:
+        preemption.uninstall()
+        prefetch.close_all(prefetchers)
+        ckpt.close()
+        metrics.close()
     return result
 
 

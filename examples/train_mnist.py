@@ -38,6 +38,7 @@ from k8s_distributed_deeplearning_tpu.train import (
     ShardedBatcher,
     data as data_lib,
     loop,
+    prefetch,
 )
 from k8s_distributed_deeplearning_tpu.utils.metrics import MetricsLogger
 
@@ -45,6 +46,8 @@ from k8s_distributed_deeplearning_tpu.utils.metrics import MetricsLogger
 def main(argv: list[str] | None = None) -> dict:
     parser = argparse.ArgumentParser(description=__doc__)
     cfg.add_train_flags(parser)
+    parser.add_argument("--prefetch", type=int, default=2,
+                        help="batches staged ahead by a host thread (0 = off)")
     args = parser.parse_args(argv)
     conf = cfg.train_config_from_args(args)
 
@@ -98,39 +101,46 @@ def main(argv: list[str] | None = None) -> dict:
 
     # Assemble host-local batches into global sharded arrays (multi-host
     # safe); resumable from any step for replay-free checkpoint restore.
+    # A host thread stages --prefetch batches ahead (train/prefetch.py).
+    prefetchers: list = []
+
     def global_batches(start_step: int):
-        return (dp.make_global_batch(b, mesh)
-                for b in batcher.iter_from(start_step))
+        return prefetch.maybe(batcher.iter_from(start_step),
+                              lambda b: dp.make_global_batch(b, mesh),
+                              args.prefetch, prefetchers)
 
-    state = loop.fit(
-        step_fn, state, global_batches, num_steps, rng,
-        metrics=metrics, checkpointer=ckpt,
-        checkpoint_every=conf.checkpoint_every, log_every=conf.log_every,
-        global_batch_size=conf.batch_size * world,
-        flops_per_example=mnist.flops_per_example(),
-        peak_flops=mesh_lib.peak_flops_per_device(conf.dtype),
-    )
+    try:
+        state = loop.fit(
+            step_fn, state, global_batches, num_steps, rng,
+            metrics=metrics, checkpointer=ckpt,
+            checkpoint_every=conf.checkpoint_every, log_every=conf.log_every,
+            global_batch_size=conf.batch_size * world,
+            flops_per_example=mnist.flops_per_example(),
+            peak_flops=mesh_lib.peak_flops_per_device(conf.dtype),
+        )
 
-    result: dict = {"num_steps": num_steps, "world_size": world}
-    if conf.eval_final:
-        # Every process runs eval (params live on the global mesh, so all
-        # processes must participate in the jitted computation); identical
-        # replicated inputs on each host; only the primary emits/reports —
-        # the rank-0 discipline of tensorflow_mnist_gpu.py:184-188.
-        test_x, test_y = data_lib.load_or_synthesize(conf.data_dir, "test",
-                                                     seed=conf.seed)
-        eval_step = jax.jit(lambda p, b: mnist.eval_fn(model, p, b))
-        n = min(len(test_x), 2000)
-        bs = 200
-        ev = loop.evaluate(eval_step, state.params,
-                           iter(ShardedBatcher(test_x[:n], test_y[:n], bs,
-                                               seed=conf.seed)),
-                           num_batches=max(1, n // bs))
-        metrics.emit("eval", **{k: float(v) for k, v in ev.items()})
-        if distributed.is_primary():
-            result.update(ev)
-    ckpt.close()
-    metrics.close()
+        result: dict = {"num_steps": num_steps, "world_size": world}
+        if conf.eval_final:
+            # Every process runs eval (params live on the global mesh, so all
+            # processes must participate in the jitted computation); identical
+            # replicated inputs on each host; only the primary emits/reports —
+            # the rank-0 discipline of tensorflow_mnist_gpu.py:184-188.
+            test_x, test_y = data_lib.load_or_synthesize(conf.data_dir, "test",
+                                                         seed=conf.seed)
+            eval_step = jax.jit(lambda p, b: mnist.eval_fn(model, p, b))
+            n = min(len(test_x), 2000)
+            bs = 200
+            ev = loop.evaluate(eval_step, state.params,
+                               iter(ShardedBatcher(test_x[:n], test_y[:n], bs,
+                                                   seed=conf.seed)),
+                               num_batches=max(1, n // bs))
+            metrics.emit("eval", **{k: float(v) for k, v in ev.items()})
+            if distributed.is_primary():
+                result.update(ev)
+    finally:
+        prefetch.close_all(prefetchers)
+        ckpt.close()
+        metrics.close()
     return result
 
 

@@ -33,7 +33,7 @@ from k8s_distributed_deeplearning_tpu.models import llama as llama_lib
 from k8s_distributed_deeplearning_tpu.parallel import (
     data_parallel as dp, distributed, mesh as mesh_lib, sharding)
 from k8s_distributed_deeplearning_tpu.train import (
-    Checkpointer, ShardedBatcher, data as data_lib, loop, optim)
+    Checkpointer, ShardedBatcher, data as data_lib, loop, optim, prefetch)
 from k8s_distributed_deeplearning_tpu.utils.metrics import MetricsLogger
 
 MODELS = ("resnet18", "resnet50", "vit", "vit-l", "bert", "bert-base", "moe")
@@ -88,6 +88,10 @@ def main(argv: list[str] | None = None) -> dict:
     ap.add_argument("--optimizer", choices=optim.OPTIMIZERS, default="adamw")
     ap.add_argument("--schedule", choices=optim.SCHEDULES, default="constant")
     ap.add_argument("--warmup-steps", type=int, default=0)
+    ap.add_argument("--grad-clip", type=float, default=1.0,
+                    help="global-norm gradient clip (0 disables)")
+    ap.add_argument("--prefetch", type=int, default=2,
+                    help="batches staged ahead by a host thread (0 = off)")
     args = ap.parse_args(argv)
     conf = cfg.train_config_from_args(args)
 
@@ -105,15 +109,25 @@ def main(argv: list[str] | None = None) -> dict:
     num_steps = conf.num_steps
     lr = optim.make_schedule(args.schedule, conf.lr, num_steps,
                              args.warmup_steps)
-    optimizer = optim.make_optimizer(args.optimizer, lr)
+    optimizer = optim.make_optimizer(args.optimizer, lr,
+                                     grad_clip=args.grad_clip or None)
 
     metrics = MetricsLogger(enabled=distributed.is_primary(),
                             job=f"zoo-{args.model}")
     ckpt = Checkpointer(conf.checkpoint_dir,
                         max_to_keep=conf.max_checkpoints_to_keep)
     rng = jax.random.key(conf.seed)
-    local_replicas = max(topo.num_devices // topo.num_processes, 1)
-    per_host = conf.batch_size * local_replicas
+    prefetchers: list = []
+
+    def _maybe_prefetch(it, place):
+        return prefetch.maybe(it, place, args.prefetch, prefetchers)
+
+    # batch_size is PER-REPLICA (TrainConfig contract): the batch only shards
+    # over the data(+fsdp) axes, so scale by those — not by all local devices,
+    # which would silently inflate the per-replica batch under tp/expert.
+    batch_shards = (mesh.shape.get("data", 1) * mesh.shape.get("fsdp", 1))
+    global_batch = conf.batch_size * batch_shards
+    per_host = max(1, global_batch // topo.num_processes)
 
     if args.model.startswith("resnet"):
         size = args.image_size or (224 if args.model == "resnet50" else 32)
@@ -134,9 +148,10 @@ def main(argv: list[str] | None = None) -> dict:
                                  process_index=topo.process_index,
                                  num_processes=topo.num_processes)
 
+        place = lambda b: dp.make_global_batch(b, mesh)
+
         def global_batches(start):
-            return (dp.make_global_batch(b, mesh)
-                    for b in batcher.iter_from(start))
+            return _maybe_prefetch(batcher.iter_from(start), place)
     else:
         if args.model in ("vit", "vit-l"):
             mcfg = (vit.config_vit_l16() if args.model == "vit-l"
@@ -195,23 +210,27 @@ def main(argv: list[str] | None = None) -> dict:
         step_fn = trainer.make_step(donate=False)
 
         def global_batches(start):
-            return (trainer.shard_batch(b) for b in batcher.iter_from(start))
+            return _maybe_prefetch(batcher.iter_from(start),
+                                   trainer.shard_batch)
 
     metrics.emit("start", model=args.model, world_size=topo.world_size,
                  num_steps=num_steps, optimizer=args.optimizer,
-                 schedule=args.schedule,
+                 schedule=args.schedule, global_batch_size=global_batch,
                  mesh={k: int(v) for k, v in
                        zip(mesh.axis_names, mesh.devices.shape)})
-    state = loop.fit(step_fn, state, global_batches, num_steps, rng,
-                     metrics=metrics, checkpointer=ckpt,
-                     checkpoint_every=conf.checkpoint_every,
-                     log_every=conf.log_every,
-                     global_batch_size=conf.batch_size * topo.world_size)
+    try:
+        state = loop.fit(step_fn, state, global_batches, num_steps, rng,
+                         metrics=metrics, checkpointer=ckpt,
+                         checkpoint_every=conf.checkpoint_every,
+                         log_every=conf.log_every,
+                         global_batch_size=global_batch)
 
-    final = {"num_steps": int(jax.device_get(state.step)),
-             "world_size": topo.world_size, "model": args.model}
-    ckpt.close()
-    metrics.close()
+        final = {"num_steps": int(jax.device_get(state.step)),
+                 "world_size": topo.world_size, "model": args.model}
+    finally:
+        prefetch.close_all(prefetchers)
+        ckpt.close()
+        metrics.close()
     return final
 
 

@@ -81,8 +81,6 @@ class Checkpointer:
         loads on a different machine shape (Orbax's "populate sharding from
         file" path is explicitly avoided — it references save-time devices).
         """
-        import os
-
         step = self._mgr.latest_step()
         if step is None:
             return None
@@ -103,8 +101,18 @@ class Checkpointer:
             return jax.ShapeDtypeStruct(m.shape, m.dtype, sharding=sharding)
 
         abstract = jax.tree_util.tree_map_with_path(to_abstract, tree)
+        # Explicit restore_args carry the target sharding into orbax — without
+        # them PyTreeRestore falls back to the persisted sharding file, which
+        # references save-time devices and fails on a different topology.
+        is_leaf = lambda x: x is ocp.PLACEHOLDER or isinstance(
+            x, jax.ShapeDtypeStruct)
+        restore_args = jax.tree.map(
+            lambda x: (ocp.ArrayRestoreArgs(sharding=sharding)
+                       if isinstance(x, jax.ShapeDtypeStruct)
+                       else ocp.RestoreArgs()),
+            abstract, is_leaf=is_leaf)
         restored = ckptr.restore(path, args=ocp.args.PyTreeRestore(
-            item=abstract))
+            item=abstract, restore_args=restore_args))
 
         def collapse(node):
             # flax Partitioned boxes serialize as a {'value': ...} dict level;

@@ -1,0 +1,115 @@
+"""Background batch prefetching — overlap host data work with device compute.
+
+The reference's input path is synchronous: ``feed_dict`` copies the next
+numpy batch to device inside the step loop (``tensorflow_mnist.py:165-171``),
+serializing host batch assembly with device execution. Here a daemon thread
+runs the (host-side) batch iterator and device placement ``depth`` steps
+ahead, so when the train loop asks for batch N+1 its transfer already
+happened while the device computed step N. JAX's async dispatch hides the
+*compute*; this hides the *host+transfer* side.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable, Iterator
+
+PyTree = Any
+
+_SENTINEL = object()
+
+
+class Prefetcher:
+    """Iterator wrapper: pulls from *source*, applies *place_fn* (e.g.
+    ``trainer.shard_batch``), and keeps up to *depth* placed batches queued.
+
+    Exceptions in the worker propagate to the consumer on the next
+    ``__next__``. Always ``close()`` (or exhaust) to stop the thread; usable
+    as a context manager.
+    """
+
+    def __init__(self, source: Iterator[PyTree],
+                 place_fn: Callable[[PyTree], PyTree] | None = None,
+                 depth: int = 2):
+        if depth < 1:
+            raise ValueError("depth must be >= 1")
+        self._source = source
+        self._place = place_fn or (lambda x: x)
+        self._q: queue.Queue = queue.Queue(maxsize=depth)
+        self._stop = threading.Event()
+        self._error: BaseException | None = None
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        try:
+            for item in self._source:
+                if self._stop.is_set():
+                    return
+                placed = self._place(item)
+                while not self._stop.is_set():
+                    try:
+                        self._q.put(placed, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+                if self._stop.is_set():
+                    return
+        except BaseException as e:          # noqa: BLE001 — must surface
+            self._error = e
+        finally:
+            while not self._stop.is_set():
+                try:
+                    self._q.put(_SENTINEL, timeout=0.1)
+                    break
+                except queue.Full:
+                    continue
+
+    def __iter__(self) -> "Prefetcher":
+        return self
+
+    def __next__(self) -> PyTree:
+        item = self._q.get()
+        if item is _SENTINEL:
+            if self._error is not None:
+                raise self._error
+            raise StopIteration
+        return item
+
+    def close(self) -> None:
+        self._stop.set()
+        # Drain so a blocked put wakes up and the thread can exit.
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+        self._thread.join(timeout=5)
+
+    def __enter__(self) -> "Prefetcher":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def maybe(source: Iterator[PyTree],
+          place_fn: Callable[[PyTree], PyTree],
+          depth: int,
+          registry: list | None = None) -> Iterator[PyTree]:
+    """Shared CLI wiring: threaded prefetch when ``depth > 0``, else a plain
+    mapping generator. Threaded instances are appended to *registry* so the
+    caller can ``close_all(registry)`` in a finally block (a leaked worker
+    keeps device batches pinned)."""
+    if depth > 0:
+        p = Prefetcher(source, place_fn=place_fn, depth=depth)
+        if registry is not None:
+            registry.append(p)
+        return p
+    return (place_fn(b) for b in source)
+
+
+def close_all(registry: list) -> None:
+    for p in registry:
+        p.close()
+    registry.clear()

@@ -1,0 +1,70 @@
+"""Prefetcher: ordering, placement, error propagation, clean shutdown."""
+import itertools
+import threading
+import time
+
+import pytest
+
+from k8s_distributed_deeplearning_tpu.train.prefetch import Prefetcher
+
+
+def test_order_and_placement_preserved():
+    with Prefetcher(iter(range(20)), place_fn=lambda x: x * 10) as p:
+        got = [next(p) for _ in range(20)]
+    assert got == [i * 10 for i in range(20)]
+
+
+def test_exhaustion_raises_stopiteration():
+    p = Prefetcher(iter([1, 2]))
+    assert list(p) == [1, 2]
+    p.close()
+
+
+def test_worker_exception_propagates():
+    def bad():
+        yield 1
+        raise RuntimeError("boom in data pipeline")
+
+    p = Prefetcher(bad())
+    assert next(p) == 1
+    with pytest.raises(RuntimeError, match="boom"):
+        next(p)
+    p.close()
+
+
+def test_runs_ahead_of_consumer():
+    produced = []
+
+    def slow_consumer_source():
+        for i in range(10):
+            produced.append(i)
+            yield i
+
+    p = Prefetcher(slow_consumer_source(), depth=4)
+    time.sleep(0.3)
+    # Worker filled the queue without the consumer asking (depth + in-flight).
+    assert len(produced) >= 4
+    assert next(p) == 0
+    p.close()
+
+
+def test_close_stops_infinite_source():
+    alive = {"n": 0}
+
+    def infinite():
+        for i in itertools.count():
+            alive["n"] = i
+            yield i
+
+    p = Prefetcher(infinite(), depth=2)
+    next(p)
+    p.close()
+    n_at_close = alive["n"]
+    time.sleep(0.2)
+    assert alive["n"] <= n_at_close + 2, "worker kept producing after close"
+    assert not p._thread.is_alive()
+
+
+def test_bad_depth_rejected():
+    with pytest.raises(ValueError, match="depth"):
+        Prefetcher(iter([]), depth=0)

@@ -69,3 +69,27 @@ def test_chips_per_worker_derived_from_topology():
     job = _job(JobConfig(tpu_topology="4x4", num_workers=4))
     res = job["spec"]["template"]["spec"]["containers"][0]["resources"]
     assert res["limits"]["google.com/tpu"] == "4"
+
+
+def test_deploy_assets_are_valid():
+    """Shipped deploy artifacts parse: bash syntax, manifest YAML, dashboard
+    JSON — the render-only analog of the reference's smoke-by-deployment."""
+    import json
+    import os
+    import subprocess
+
+    import yaml
+
+    root = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "deploy")
+    subprocess.run(["bash", "-n", os.path.join(root, "deploy_stack.sh")],
+                   check=True)
+    docs = list(yaml.safe_load_all(open(os.path.join(root,
+                                                     "tpujob-mnist.yaml"))))
+    assert [d["kind"] for d in docs] == ["Namespace", "Service", "Job"]
+    job = docs[2]
+    env = {e["name"] for e in
+           job["spec"]["template"]["spec"]["containers"][0]["env"]}
+    assert {"TPUJOB_COORDINATOR_ADDRESS", "TPUJOB_NUM_PROCESSES",
+            "TPUJOB_PROCESS_ID"} <= env
+    json.load(open(os.path.join(root, "grafana-dashboard.json")))
