@@ -22,8 +22,10 @@ REPO = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, REPO)
 
 
-def measure(batch_size: int, steps: int, warmup: int, dtype: str) -> float:
-    """Images/sec of the jitted DP train step on the current backend."""
+def measure(batch_size: int, steps: int, warmup: int, dtype: str,
+            repeats: int = 1) -> list[float]:
+    """Images/sec of the jitted DP train step, *repeats* timing windows over
+    ONE compiled step (setup and compile paid once)."""
     import jax
     import jax.numpy as jnp
     import optax
@@ -51,13 +53,16 @@ def measure(batch_size: int, steps: int, warmup: int, dtype: str) -> float:
     # block_until_ready can return before execution really finishes, which
     # would flatter the number. float() forces the bytes to the host.
     float(loss)
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        state, loss, _ = step(state, batch, rng)
-    final = float(loss)
-    dt = time.perf_counter() - t0
-    assert final == final, "NaN loss in benchmark"
-    return batch_size * steps / dt
+    out = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, loss, _ = step(state, batch, rng)
+        final = float(loss)
+        dt = time.perf_counter() - t0
+        assert final == final, "NaN loss in benchmark"
+        out.append(batch_size * steps / dt)
+    return out
 
 
 def main() -> None:
@@ -82,16 +87,16 @@ def main() -> None:
         jax.config.update("jax_platforms", "cpu")
         jax.config.update("jax_platform_name", "cpu")
         assert jax.devices()[0].platform == "cpu", jax.devices()
-        ips = measure(batch_size=100, steps=10, warmup=2, dtype="float32")
+        ips = measure(batch_size=100, steps=10, warmup=2, dtype="float32")[0]
         print(json.dumps({"cpu_images_per_sec": ips}))
         return
 
     import jax
     n_chips = jax.device_count()
-    # Median of 3 runs: remote-tunnel dispatch latency varies run to run;
-    # the compiled computation is cached after the first.
+    # Median of 3 timing windows over one compiled step: remote-tunnel
+    # dispatch latency varies window to window, compile is paid once.
     runs = sorted(measure(args.batch_size, args.steps, args.warmup,
-                          dtype="bfloat16") for _ in range(3))
+                          dtype="bfloat16", repeats=3))
     per_chip = runs[1] / n_chips
 
     baseline = None

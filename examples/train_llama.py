@@ -101,12 +101,8 @@ def main(argv: list[str] | None = None) -> dict:
     parser.add_argument("--schedule", choices=optim.SCHEDULES,
                         default="constant")
     parser.add_argument("--warmup-steps", type=int, default=0)
-    parser.add_argument("--grad-clip", type=float, default=1.0,
-                        help="global-norm gradient clip (0 disables)")
     parser.add_argument("--profile-dir", type=str, default=None,
                         help="capture a jax.profiler trace of steps 10..15")
-    parser.add_argument("--prefetch", type=int, default=2,
-                        help="batches staged ahead by a host thread (0 = off)")
     args = parser.parse_args(argv)
     conf = cfg.train_config_from_args(args)
 
@@ -125,9 +121,14 @@ def main(argv: list[str] | None = None) -> dict:
     model = llama.LlamaLM(model_cfg)
 
     attention_fn = None
+    cp_impl = None
     if use_cp:
-        impl = args.attention if args.attention in ("ring", "ulysses") else "ring"
-        attention_fn = cp.make_context_parallel_attention(mesh, impl)
+        # --attention flash with --sp resolves to ring (itself blockwise
+        # online-softmax, i.e. flash-structured); the resolved scheme is
+        # reported in the start event so the substitution is visible.
+        cp_impl = (args.attention if args.attention in ("ring", "ulysses")
+                   else "ring")
+        attention_fn = cp.make_context_parallel_attention(mesh, cp_impl)
 
     def loss(params, batch, rng):
         toks = batch["tokens"]
@@ -184,7 +185,9 @@ def main(argv: list[str] | None = None) -> dict:
                  preset=args.preset, params=n_params, seq_len=seq_len,
                  mesh={k: int(v) for k, v in
                        zip(mesh.axis_names, mesh.devices.shape)},
-                 attention=args.attention, platform=topo.platform)
+                 attention=args.attention,
+                 **({"cp_impl": cp_impl} if cp_impl else {}),
+                 platform=topo.platform)
 
     prefetchers: list = []
 

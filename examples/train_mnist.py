@@ -24,7 +24,6 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 import jax.numpy as jnp
-import optax
 
 from k8s_distributed_deeplearning_tpu import config as cfg
 from k8s_distributed_deeplearning_tpu.models import mnist
@@ -38,6 +37,7 @@ from k8s_distributed_deeplearning_tpu.train import (
     ShardedBatcher,
     data as data_lib,
     loop,
+    optim,
     prefetch,
 )
 from k8s_distributed_deeplearning_tpu.utils.metrics import MetricsLogger
@@ -46,8 +46,6 @@ from k8s_distributed_deeplearning_tpu.utils.metrics import MetricsLogger
 def main(argv: list[str] | None = None) -> dict:
     parser = argparse.ArgumentParser(description=__doc__)
     cfg.add_train_flags(parser)
-    parser.add_argument("--prefetch", type=int, default=2,
-                        help="batches staged ahead by a host thread (0 = off)")
     args = parser.parse_args(argv)
     conf = cfg.train_config_from_args(args)
 
@@ -65,7 +63,8 @@ def main(argv: list[str] | None = None) -> dict:
     lr = conf.scaled_lr(world, topo.local_size,
                         mesh_lib.fast_interconnect_available())
     num_steps = conf.steps_for_world(world)
-    optimizer = optax.adam(lr)
+    optimizer = optim.make_optimizer("adam", lr,
+                                     grad_clip=args.grad_clip or None)
     reduction = dp.Reduction.ADASUM if conf.use_adasum else dp.Reduction.AVERAGE
 
     rng = jax.random.key(conf.seed)

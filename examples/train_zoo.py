@@ -88,10 +88,6 @@ def main(argv: list[str] | None = None) -> dict:
     ap.add_argument("--optimizer", choices=optim.OPTIMIZERS, default="adamw")
     ap.add_argument("--schedule", choices=optim.SCHEDULES, default="constant")
     ap.add_argument("--warmup-steps", type=int, default=0)
-    ap.add_argument("--grad-clip", type=float, default=1.0,
-                    help="global-norm gradient clip (0 disables)")
-    ap.add_argument("--prefetch", type=int, default=2,
-                    help="batches staged ahead by a host thread (0 = off)")
     args = ap.parse_args(argv)
     conf = cfg.train_config_from_args(args)
 
@@ -127,7 +123,12 @@ def main(argv: list[str] | None = None) -> dict:
     # which would silently inflate the per-replica batch under tp/expert.
     batch_shards = (mesh.shape.get("data", 1) * mesh.shape.get("fsdp", 1))
     global_batch = conf.batch_size * batch_shards
-    per_host = max(1, global_batch // topo.num_processes)
+    if global_batch % topo.num_processes:
+        raise ValueError(
+            f"global batch {global_batch} (= batch_size {conf.batch_size} x "
+            f"{batch_shards} data/fsdp shards) must divide evenly across "
+            f"{topo.num_processes} processes — adjust --batch-size")
+    per_host = global_batch // topo.num_processes
 
     if args.model.startswith("resnet"):
         size = args.image_size or (224 if args.model == "resnet50" else 32)

@@ -141,6 +141,10 @@ def add_train_flags(parser: argparse.ArgumentParser,
                         choices=["float32", "bfloat16"])
     parser.add_argument("--no-eval", dest="eval_final", action="store_false",
                         default=d.eval_final)
+    parser.add_argument("--prefetch", type=int, default=2,
+                        help="batches staged ahead by a host thread (0 = off)")
+    parser.add_argument("--grad-clip", type=float, default=1.0,
+                        help="global-norm gradient clip (0 disables)")
 
 
 def train_config_from_args(args: argparse.Namespace) -> TrainConfig:

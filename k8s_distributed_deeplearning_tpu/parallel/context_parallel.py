@@ -150,15 +150,28 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
 
 def make_context_parallel_attention(
         mesh: Mesh, impl: str = "ring", axis_name: str = "sequence",
-        batch_axes=("data", "fsdp")) -> Callable:
+        batch_axes=("data", "fsdp"), inner_impl: str = "xla") -> Callable:
     """Wrap ring/Ulysses attention as an ``attention_fn`` for the transformer
     core under the jit-based :class:`~parallel.sharding.ShardedTrainer`.
 
     The returned fn takes *global* [B,S,H,D] arrays (jit view); shard_map
     splits batch over the data axes and sequence over ``axis_name``, runs the
     SPMD kernel, and hands jit back a seq-sharded global output.
+    ``inner_impl="flash"`` runs Ulysses' per-device full-sequence attention
+    through the Pallas flash kernel (ring's blockwise loop is already
+    flash-structured).
     """
+    if inner_impl not in ("xla", "flash"):
+        raise ValueError(f"inner_impl must be 'xla' or 'flash', got {inner_impl!r}")
+    if impl == "ring" and inner_impl == "flash":
+        raise ValueError(
+            "inner_impl='flash' applies to Ulysses only — ring attention is "
+            "already blockwise online-softmax (flash-structured) by design")
     fn = {"ring": ring_attention, "ulysses": ulysses_attention}[impl]
+    if impl == "ulysses" and inner_impl == "flash":
+        from k8s_distributed_deeplearning_tpu.ops.pallas_flash import (
+            flash_attention)
+        fn = functools.partial(ulysses_attention, inner=flash_attention)
     batch = tuple(a for a in batch_axes if a in mesh.axis_names)
     spec = P(batch or None, axis_name, None, None)
 

@@ -71,6 +71,13 @@ class Prefetcher:
     def __next__(self) -> PyTree:
         item = self._q.get()
         if item is _SENTINEL:
+            # Re-queue the sentinel so the terminal state stays observable:
+            # a second next() after exhaustion/error/close must raise again,
+            # not block forever on an empty queue with a dead worker.
+            try:
+                self._q.put_nowait(_SENTINEL)
+            except queue.Full:
+                pass
             if self._error is not None:
                 raise self._error
             raise StopIteration
@@ -85,6 +92,10 @@ class Prefetcher:
         except queue.Empty:
             pass
         self._thread.join(timeout=5)
+        try:
+            self._q.put_nowait(_SENTINEL)   # post-close next() raises, no hang
+        except queue.Full:
+            pass
 
     def __enter__(self) -> "Prefetcher":
         return self

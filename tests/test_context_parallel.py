@@ -104,3 +104,15 @@ def test_llama_trains_with_ring_attention():
     ref = losses_on(mesh_lib.make_mesh({"data": 8}))
     assert got[-1] < got[0]
     np.testing.assert_allclose(got, ref, rtol=2e-4)
+
+
+def test_ulysses_with_flash_inner_matches_reference():
+    """Ulysses sequence parallelism with the Pallas flash kernel as the
+    per-device attention — both long-context levers composed."""
+    q, k, v = _qkv(hq=8, s=64)
+    ref = attn_ops.dot_product_attention(q, k, v, causal=True)
+    mesh = mesh_lib.make_mesh({"sequence": 8})
+    fn = cp.make_context_parallel_attention(mesh, "ulysses",
+                                            inner_impl="flash")
+    out = jax.jit(lambda q, k, v: fn(q, k, v, causal=True))(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)

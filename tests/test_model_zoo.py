@@ -165,3 +165,17 @@ def test_vit_trains_on_mixed_mesh():
         state, l, _ = step(state, batch, jax.random.key(i))
         losses.append(float(l))
     assert losses[-1] < losses[0]
+
+
+def test_llama3_8b_architecture_param_count():
+    """The 8B preset must actually be the 8B architecture (~8.03B params),
+    verified via eval_shape — no memory materialized."""
+    from k8s_distributed_deeplearning_tpu.models import llama
+    cfg = llama.config_llama3_8b()
+    model = llama.LlamaLM(cfg)
+
+    variables = jax.eval_shape(
+        lambda: model.init(jax.random.key(0),
+                           jnp.zeros((1, 8), jnp.int32)))
+    n = sum(int(np.prod(l.shape)) for l in jax.tree.leaves(variables["params"]))
+    assert 7.9e9 < n < 8.2e9, f"{n/1e9:.2f}B params"

@@ -68,3 +68,25 @@ def test_close_stops_infinite_source():
 def test_bad_depth_rejected():
     with pytest.raises(ValueError, match="depth"):
         Prefetcher(iter([]), depth=0)
+
+
+def test_repeated_next_after_exhaustion_keeps_raising():
+    """Post-exhaustion (and post-close) next() must raise, never hang."""
+    p = Prefetcher(iter([1]))
+    assert next(p) == 1
+    for _ in range(3):
+        with pytest.raises(StopIteration):
+            next(p)
+    p.close()
+    with pytest.raises(StopIteration):
+        next(p)
+
+    def bad():
+        raise RuntimeError("immediate failure")
+        yield  # pragma: no cover
+
+    p2 = Prefetcher(bad())
+    for _ in range(2):          # error stays observable on every call
+        with pytest.raises(RuntimeError, match="immediate"):
+            next(p2)
+    p2.close()
