@@ -103,6 +103,7 @@ def main(argv: list[str] | None = None) -> dict:
     parser.add_argument("--warmup-steps", type=int, default=0)
     parser.add_argument("--profile-dir", type=str, default=None,
                         help="capture a jax.profiler trace of steps 10..15")
+    parser.set_defaults(grad_clip=1.0)   # LM pretraining hygiene default
     args = parser.parse_args(argv)
     conf = cfg.train_config_from_args(args)
 
@@ -121,14 +122,23 @@ def main(argv: list[str] | None = None) -> dict:
     model = llama.LlamaLM(model_cfg)
 
     attention_fn = None
-    cp_impl = None
+    cp_impl = cp_inner = None
     if use_cp:
-        # --attention flash with --sp resolves to ring (itself blockwise
-        # online-softmax, i.e. flash-structured); the resolved scheme is
-        # reported in the start event so the substitution is visible.
-        cp_impl = (args.attention if args.attention in ("ring", "ulysses")
-                   else "ring")
-        attention_fn = cp.make_context_parallel_attention(mesh, cp_impl)
+        # Resolution when sequence parallelism is on: explicit ring/ulysses
+        # keep the XLA inner; --attention flash composes Ulysses with the
+        # Pallas kernel when the head count divides the sequence axis, else
+        # ring (itself blockwise online-softmax, i.e. flash-structured).
+        # The resolved scheme lands in the start event so substitutions are
+        # visible.
+        sp_size = mesh.shape["sequence"]
+        if args.attention in ("ring", "ulysses"):
+            cp_impl, cp_inner = args.attention, "xla"
+        elif args.attention == "flash" and model_cfg.n_heads % sp_size == 0:
+            cp_impl, cp_inner = "ulysses", "flash"
+        else:
+            cp_impl, cp_inner = "ring", "xla"
+        attention_fn = cp.make_context_parallel_attention(
+            mesh, cp_impl, inner_impl=cp_inner)
 
     def loss(params, batch, rng):
         toks = batch["tokens"]
@@ -153,7 +163,7 @@ def main(argv: list[str] | None = None) -> dict:
     trainer = sharding.ShardedTrainer(loss, optimizer, mesh)
     init = lambda r: model.init(r, jnp.zeros((1, 8), jnp.int32))["params"]
     state = trainer.init(init, jax.random.key(conf.seed))
-    step_fn = trainer.make_step(donate=False)
+    step_fn = trainer.make_step(donate=True)
 
     tokens = data_lib.load_tokens(args.data_path,
                                   vocab_size=model_cfg.vocab_size,
@@ -165,8 +175,14 @@ def main(argv: list[str] | None = None) -> dict:
     eval_tokens, tokens = tokens[-n_eval:], tokens[:-n_eval]
     # Per-host batch: the global batch split across processes (each host
     # contributes its local slice; shard_batch assembles the global array).
+    # Checked BEFORE metrics/checkpointer construction so a config error
+    # can't leak resources; never silently resized.
     global_batch = conf.batch_size
-    per_host = max(1, global_batch // topo.num_processes)
+    if global_batch % topo.num_processes:
+        raise ValueError(
+            f"--batch-size {global_batch} (global) must divide evenly across "
+            f"{topo.num_processes} processes")
+    per_host = global_batch // topo.num_processes
     batcher = data_lib.TokenBatcher(tokens, per_host, seq_len,
                                     seed=conf.seed,
                                     process_index=topo.process_index,
@@ -186,7 +202,8 @@ def main(argv: list[str] | None = None) -> dict:
                  mesh={k: int(v) for k, v in
                        zip(mesh.axis_names, mesh.devices.shape)},
                  attention=args.attention,
-                 **({"cp_impl": cp_impl} if cp_impl else {}),
+                 **({"cp_impl": cp_impl, "cp_inner": cp_inner}
+                    if cp_impl else {}),
                  platform=topo.platform)
 
     prefetchers: list = []

@@ -88,6 +88,7 @@ def main(argv: list[str] | None = None) -> dict:
     ap.add_argument("--optimizer", choices=optim.OPTIMIZERS, default="adamw")
     ap.add_argument("--schedule", choices=optim.SCHEDULES, default="constant")
     ap.add_argument("--warmup-steps", type=int, default=0)
+    ap.set_defaults(grad_clip=1.0)       # transformer-training default
     args = ap.parse_args(argv)
     conf = cfg.train_config_from_args(args)
 
@@ -108,6 +109,20 @@ def main(argv: list[str] | None = None) -> dict:
     optimizer = optim.make_optimizer(args.optimizer, lr,
                                      grad_clip=args.grad_clip or None)
 
+    # batch_size is PER-REPLICA (TrainConfig contract): the batch only shards
+    # over the data(+fsdp) axes, so scale by those — not by all local devices,
+    # which would silently inflate the per-replica batch under tp/expert.
+    # Validated BEFORE any resource construction (metrics stream, orbax
+    # manager) so a config error can't leak them.
+    batch_shards = (mesh.shape.get("data", 1) * mesh.shape.get("fsdp", 1))
+    global_batch = conf.batch_size * batch_shards
+    if global_batch % topo.num_processes:
+        raise ValueError(
+            f"global batch {global_batch} (= batch_size {conf.batch_size} x "
+            f"{batch_shards} data/fsdp shards) must divide evenly across "
+            f"{topo.num_processes} processes — adjust --batch-size")
+    per_host = global_batch // topo.num_processes
+
     metrics = MetricsLogger(enabled=distributed.is_primary(),
                             job=f"zoo-{args.model}")
     ckpt = Checkpointer(conf.checkpoint_dir,
@@ -117,18 +132,6 @@ def main(argv: list[str] | None = None) -> dict:
 
     def _maybe_prefetch(it, place):
         return prefetch.maybe(it, place, args.prefetch, prefetchers)
-
-    # batch_size is PER-REPLICA (TrainConfig contract): the batch only shards
-    # over the data(+fsdp) axes, so scale by those — not by all local devices,
-    # which would silently inflate the per-replica batch under tp/expert.
-    batch_shards = (mesh.shape.get("data", 1) * mesh.shape.get("fsdp", 1))
-    global_batch = conf.batch_size * batch_shards
-    if global_batch % topo.num_processes:
-        raise ValueError(
-            f"global batch {global_batch} (= batch_size {conf.batch_size} x "
-            f"{batch_shards} data/fsdp shards) must divide evenly across "
-            f"{topo.num_processes} processes — adjust --batch-size")
-    per_host = global_batch // topo.num_processes
 
     if args.model.startswith("resnet"):
         size = args.image_size or (224 if args.model == "resnet50" else 32)
@@ -208,7 +211,7 @@ def main(argv: list[str] | None = None) -> dict:
 
         trainer = sharding.ShardedTrainer(loss, optimizer, mesh)
         state = trainer.init(init, rng)
-        step_fn = trainer.make_step(donate=False)
+        step_fn = trainer.make_step(donate=True)
 
         def global_batches(start):
             return _maybe_prefetch(batcher.iter_from(start),

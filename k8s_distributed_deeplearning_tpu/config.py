@@ -143,7 +143,10 @@ def add_train_flags(parser: argparse.ArgumentParser,
                         default=d.eval_final)
     parser.add_argument("--prefetch", type=int, default=2,
                         help="batches staged ahead by a host thread (0 = off)")
-    parser.add_argument("--grad-clip", type=float, default=1.0,
+    # Default OFF: the reference parity path (mnist) uses bare Adam
+    # (tensorflow_mnist.py:123-130). The LM scripts override the default to
+    # 1.0 via parser.set_defaults — standard pretraining hygiene there.
+    parser.add_argument("--grad-clip", type=float, default=0.0,
                         help="global-norm gradient clip (0 disables)")
 
 

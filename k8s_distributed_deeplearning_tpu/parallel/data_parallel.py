@@ -47,17 +47,34 @@ class Reduction(enum.Enum):
 
 def reduce_gradients(grads: PyTree, axis_name: str, axis_size: int,
                      reduction: Reduction,
-                     bucket_bytes: int | None = None) -> PyTree:
+                     bucket_bytes: "int | str | None" = None) -> PyTree:
+    """``bucket_bytes``: explicit fusion threshold in bytes, or ``"auto"`` to
+    let the native alpha-beta autotuner pick it from this gradient tree's
+    sizes (the Horovod-autotuner analog; runs once at trace time, the chosen
+    plan is baked into the compiled step)."""
     if bucket_bytes and reduction is not Reduction.AVERAGE:
         raise ValueError(
             f"bucket_bytes is only supported with Reduction.AVERAGE, "
             f"got {reduction}")
     if reduction is Reduction.AVERAGE:
         if bucket_bytes:
-            from k8s_distributed_deeplearning_tpu.runtime.fusion import FusionPlanner
+            from k8s_distributed_deeplearning_tpu.parallel.mesh import (
+                interconnect_bandwidth_estimate)
+            from k8s_distributed_deeplearning_tpu.runtime.fusion import (
+                FusionPlanner)
             leaves = jax.tree.leaves(grads)
             sizes = [l.size * l.dtype.itemsize for l in leaves]
-            ids = FusionPlanner(world=axis_size).plan(sizes, bucket_bytes)
+            if bucket_bytes == "auto":
+                # beta from the link the all-reduce actually rides (ICI on
+                # TPU; host memory on CPU backends), not host DRAM always.
+                bw = interconnect_bandwidth_estimate()
+                planner = FusionPlanner(
+                    world=axis_size,
+                    beta_s_per_byte=1.0 / bw if bw > 0 else 1.0 / 100e9)
+                bucket_bytes = planner.autotune(sizes)
+            else:
+                planner = FusionPlanner(world=axis_size)
+            ids = planner.plan(sizes, bucket_bytes)
             return collectives.bucketed_pmean(grads, axis_name, ids)
         return collectives.tree_pmean(grads, axis_name)
     if reduction is Reduction.SUM:
@@ -94,7 +111,7 @@ def make_train_step(
     mesh: Mesh,
     axis_name: str = "data",
     reduction: Reduction = Reduction.AVERAGE,
-    bucket_bytes: int | None = None,
+    bucket_bytes: "int | str | None" = None,
 ) -> Callable[[TrainState, PyTree, jax.Array], tuple[TrainState, jax.Array, Any]]:
     """Build the jitted synchronous-DP train step.
 

@@ -102,6 +102,33 @@ def fast_interconnect_available() -> bool:
     return platform in ("tpu", "axon")
 
 
+def interconnect_bandwidth_estimate() -> float:
+    """Bytes/sec estimate of the per-link collective bandwidth for the
+    current backend — the beta term for collective cost models. TPU
+    collectives ride ICI (public per-link figures below); on CPU backends
+    collectives move through host memory, so the host memcpy probe is the
+    honest estimate there.
+    """
+    dev = jax.devices()[0]
+    if dev.platform in ("tpu", "axon"):
+        kind = dev.device_kind.lower()
+        table = {  # per-link ICI bandwidth, bytes/sec (public figures)
+            "tpu v4": 1.2e11,
+            "tpu v5 lite": 4.0e10,
+            "tpu v5e": 4.0e10,
+            "tpu v5": 1.2e11,
+            "tpu v5p": 1.2e11,
+            "tpu v6": 1.8e11,
+        }
+        for key, val in table.items():
+            if key in kind:
+                return val
+        return 9e10
+    from k8s_distributed_deeplearning_tpu.runtime.fusion import (
+        probe_memcpy_bandwidth)
+    return probe_memcpy_bandwidth()
+
+
 def peak_flops_per_device(dtype: str = "bfloat16") -> float:
     """Peak matmul FLOP/s for the local device kind, for MFU accounting.
 

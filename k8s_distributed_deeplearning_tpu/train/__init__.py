@@ -1,9 +1,16 @@
-"""Training loop, data pipeline, checkpointing."""
+"""Training loop, data pipeline, checkpointing, preemption, prefetch."""
 
 from k8s_distributed_deeplearning_tpu.train.data import (  # noqa: F401
     ShardedBatcher,
+    TokenBatcher,
     load_mnist,
+    synthetic_images,
     synthetic_mnist,
+    synthetic_tokens,
 )
 from k8s_distributed_deeplearning_tpu.train.checkpoint import Checkpointer  # noqa: F401
-from k8s_distributed_deeplearning_tpu.train.loop import fit  # noqa: F401
+from k8s_distributed_deeplearning_tpu.train.loop import evaluate, fit  # noqa: F401
+from k8s_distributed_deeplearning_tpu.train.preemption import (  # noqa: F401
+    PreemptionHandler,
+)
+from k8s_distributed_deeplearning_tpu.train.prefetch import Prefetcher  # noqa: F401

@@ -92,6 +92,15 @@ class Prefetcher:
         except queue.Empty:
             pass
         self._thread.join(timeout=5)
+        # Drain AGAIN after the join: a worker blocked mid-put may have
+        # landed one more item in the freed slot before observing stop —
+        # without this second drain the sentinel put below can hit Full and
+        # a post-close next() would return a stale batch then hang.
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
         try:
             self._q.put_nowait(_SENTINEL)   # post-close next() raises, no hang
         except queue.Full:

@@ -1,3 +1,7 @@
-"""Metrics, logging, misc utilities."""
+"""Metrics, logging, profiling utilities."""
 
 from k8s_distributed_deeplearning_tpu.utils.metrics import MetricsLogger  # noqa: F401
+from k8s_distributed_deeplearning_tpu.utils.profiling import (  # noqa: F401
+    StepProfiler,
+    StepTimer,
+)

@@ -90,3 +90,40 @@ def test_lr_and_step_scaling_rules():
     ca = TrainConfig(lr=0.001, use_adasum=True)
     assert ca.scaled_lr(8, local_size=4, fast_interconnect=True) == 0.001 * 4
     assert ca.scaled_lr(8, local_size=4, fast_interconnect=False) == 0.001
+
+
+def test_auto_bucketed_reduction_trains(mesh8):
+    """bucket_bytes="auto": the native autotuner picks the fusion threshold
+    from the gradient tree and the bucketed step still trains correctly."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from k8s_distributed_deeplearning_tpu.models import mnist
+    from k8s_distributed_deeplearning_tpu.parallel import data_parallel as dp
+    from k8s_distributed_deeplearning_tpu.train import data as data_lib
+
+    model = mnist.MNISTConvNet()
+    opt = optax.adam(1e-3)
+
+    def run(bucket_bytes):
+        # Fresh params per run: the donated step invalidates its input state,
+        # and device_put may alias rather than copy an identically-placed tree.
+        params = model.init(jax.random.key(0), jnp.zeros((1, 28, 28, 1)),
+                            train=False)["params"]
+        state = dp.init_state(dp.replicate(params, mesh8), opt, mesh8)
+        step = dp.make_train_step(
+            lambda p, b, r: mnist.loss_fn(model, p, b, r), opt, mesh8,
+            bucket_bytes=bucket_bytes)
+        x, y = data_lib.synthetic_mnist(32, seed=0)
+        batch = dp.shard_batch({"image": x, "label": y}, mesh8)
+        losses = []
+        for i in range(3):
+            state, loss, _ = step(state, batch, jax.random.key(i))
+            losses.append(float(loss))
+        return losses
+
+    auto = run("auto")
+    plain = run(None)
+    assert all(np.isfinite(l) for l in auto)
+    np.testing.assert_allclose(auto, plain, rtol=1e-5)
