@@ -11,4 +11,4 @@ from k8s_distributed_deeplearning_tpu.models.bert import BertMLM  # noqa: F401
 from k8s_distributed_deeplearning_tpu.models.vit import ViT  # noqa: F401
 from k8s_distributed_deeplearning_tpu.models.resnet import ResNet  # noqa: F401
 from k8s_distributed_deeplearning_tpu.models.moe import MoELM, MoEConfig  # noqa: F401
-from k8s_distributed_deeplearning_tpu.models.generate import generate  # noqa: F401
+from k8s_distributed_deeplearning_tpu.models import generate  # noqa: F401  (module; use models.generate.generate)

@@ -19,7 +19,7 @@ import jax.numpy as jnp
 import optax
 
 from k8s_distributed_deeplearning_tpu.models.transformer import (
-    LMHead, Transformer, TransformerConfig)
+    LMHead, Transformer, TransformerConfig, packed_positions)
 
 import flax.linen as nn
 
@@ -32,11 +32,13 @@ class LlamaLM(nn.Module):
     @nn.compact
     def __call__(self, tokens: jax.Array, *,
                  positions: jax.Array | None = None,
+                 segment_ids: jax.Array | None = None,
                  deterministic: bool = True,
                  attention_fn=None,
                  decode: bool = False) -> jax.Array:
         x = Transformer(self.cfg, name="transformer")(
-            tokens, positions=positions, deterministic=deterministic,
+            tokens, positions=positions, segment_ids=segment_ids,
+            deterministic=deterministic,
             attention_fn=attention_fn, decode=decode)
         embedding = None
         if self.cfg.tie_embeddings:
@@ -65,15 +67,27 @@ def config_tiny(**overrides) -> TransformerConfig:
 
 def loss_fn(model: LlamaLM, params, batch, rng=None) -> tuple[jax.Array, dict]:
     """Next-token cross-entropy. ``batch``: {"tokens": [B,S] int32, optional
-    "mask": [B,S] 1.0 = count this position}. Shifts internally: position i
-    predicts token i+1."""
+    "mask": [B,S] 1.0 = count this position, optional "segment_ids": [B,S]
+    int32 packed-document ids (attention stays within a document, and
+    cross-document boundary positions don't count toward the loss)}.
+    Shifts internally: position i predicts token i+1."""
     tokens = batch["tokens"]
     inputs, targets = tokens[:, :-1], tokens[:, 1:]
+    seg = batch.get("segment_ids")
     rngs = {"dropout": rng} if rng is not None else None
-    logits = model.apply({"params": params}, inputs,
-                         deterministic=rng is None, rngs=rngs)
+    seg_in = None if seg is None else seg[:, :-1]
+    logits = model.apply(
+        {"params": params}, inputs,
+        segment_ids=seg_in,
+        # RoPE positions restart per packed document — without this, packed
+        # training silently diverges from training the documents unpacked.
+        positions=None if seg_in is None else packed_positions(seg_in),
+        deterministic=rng is None, rngs=rngs)
     mask = batch.get("mask")
     mask = jnp.ones_like(targets, jnp.float32) if mask is None else mask[:, 1:]
+    if seg is not None:
+        # Position i predicts i+1: only count pairs inside one document.
+        mask = mask * (seg[:, :-1] == seg[:, 1:]).astype(jnp.float32)
     ce = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
     loss = (ce * mask).sum() / jnp.maximum(mask.sum(), 1.0)
     acc = (((logits.argmax(-1) == targets) * mask).sum()

@@ -113,6 +113,20 @@ def make_norm(cfg: TransformerConfig, name: str):
         bias_init=nn.with_logical_partitioning(nn.initializers.zeros, ("embed",)))
 
 
+def packed_positions(segment_ids: jax.Array) -> jax.Array:
+    """Per-document positions for packed rows: [B, S] segment ids (contiguous
+    runs — the packing invariant) -> positions restarting at 0 at each
+    document start, so RoPE treats every packed document like an unpacked
+    one."""
+    b, s = segment_ids.shape
+    idx = jnp.arange(s)[None, :]
+    is_start = jnp.concatenate(
+        [jnp.ones((b, 1), bool),
+         segment_ids[:, 1:] != segment_ids[:, :-1]], axis=1)
+    doc_start = jax.lax.cummax(jnp.where(is_start, idx, 0), axis=1)
+    return idx - doc_start
+
+
 def rope_frequencies(head_dim: int, max_seq_len: int,
                      theta: float) -> tuple[jax.Array, jax.Array]:
     """Precompute RoPE cos/sin tables, shape [max_seq_len, head_dim/2], f32."""
@@ -165,6 +179,7 @@ class Attention(nn.Module):
     def __call__(self, x: jax.Array, *,
                  mask: jax.Array | None = None,
                  positions: jax.Array | None = None,
+                 segment_ids: jax.Array | None = None,
                  attention_fn: Callable | None = None,
                  decode: bool = False) -> jax.Array:
         cfg = self.cfg
@@ -186,7 +201,8 @@ class Attention(nn.Module):
                             name="v_proj")(x)
         cur = None
         if decode:
-            if mask is not None or attention_fn is not None:
+            if mask is not None or attention_fn is not None \
+                    or segment_ids is not None:
                 raise NotImplementedError(
                     "decode mode builds its own cache-prefix mask and local "
                     "attention; caller-provided mask/attention_fn would be "
@@ -229,11 +245,15 @@ class Attention(nn.Module):
             k = nn.with_logical_constraint(k, ("batch", "seq", "kv", "head_dim"))
             v = nn.with_logical_constraint(v, ("batch", "seq", "kv", "head_dim"))
             if attention_fn is not None:
+                if segment_ids is not None:
+                    raise NotImplementedError(
+                        "segment_ids with a custom attention_fn "
+                        "(context-parallel) is not supported yet")
                 out = attention_fn(q, k, v, causal=cfg.causal, mask=mask)
             else:
                 out = attention_ops.multi_head_attention(
                     q, k, v, causal=cfg.causal, mask=mask,
-                    impl=cfg.attention_impl)
+                    segment_ids=segment_ids, impl=cfg.attention_impl)
         out = nn.with_logical_constraint(out, ("batch", "seq", "heads", "head_dim"))
         out = nn.DenseGeneral(cfg.dim, axis=(-2, -1), use_bias=False,
                               dtype=cfg.dtype, param_dtype=jnp.float32,
@@ -282,12 +302,14 @@ class Block(nn.Module):
     def __call__(self, x: jax.Array, *,
                  mask: jax.Array | None = None,
                  positions: jax.Array | None = None,
+                 segment_ids: jax.Array | None = None,
                  deterministic: bool = True,
                  attention_fn: Callable | None = None,
                  decode: bool = False) -> jax.Array:
         cfg = self.cfg
         h = make_norm(cfg, "attn_norm")(x)
         h = Attention(cfg, name="attn")(h, mask=mask, positions=positions,
+                                        segment_ids=segment_ids,
                                         attention_fn=attention_fn,
                                         decode=decode)
         if cfg.dropout_rate:
@@ -317,6 +339,7 @@ class Transformer(nn.Module):
     def __call__(self, tokens_or_embeds: jax.Array, *,
                  mask: jax.Array | None = None,
                  positions: jax.Array | None = None,
+                 segment_ids: jax.Array | None = None,
                  deterministic: bool = True,
                  attention_fn: Callable | None = None,
                  decode: bool = False) -> jax.Array:
@@ -361,6 +384,7 @@ class Transformer(nn.Module):
             x, _ = nn.scan(
                 lambda mdl, carry, _: (
                     mdl(carry, mask=mask, positions=positions,
+                        segment_ids=segment_ids,
                         deterministic=deterministic,
                         attention_fn=attention_fn, **dkw), None),
                 variable_axes={"params": 0, "intermediates": 0, "cache": 0},
@@ -374,6 +398,7 @@ class Transformer(nn.Module):
                 x = block_cls(cfg, mlp_factory=self.mlp_factory,
                               name=f"block_{i}")(
                     x, mask=mask, positions=positions,
+                    segment_ids=segment_ids,
                     deterministic=deterministic, attention_fn=attention_fn,
                     **dkw)
         return make_norm(cfg, "final_norm")(x)

@@ -33,6 +33,15 @@ def _repeat_kv(k: jax.Array, num_q_heads: int) -> jax.Array:
     return jnp.repeat(k, num_q_heads // num_kv, axis=2)
 
 
+def segment_mask(q_segment_ids: jax.Array,
+                 kv_segment_ids: jax.Array) -> jax.Array:
+    """[B, Sq] x [B, Sk] segment ids -> [B, 1, Sq, Sk] bool mask (attend only
+    within equal ids) — the packed-sequence/padding mask, shared by the XLA
+    path here and the Pallas flash kernels."""
+    return (q_segment_ids[:, None, :, None]
+            == kv_segment_ids[:, None, None, :])
+
+
 def dot_product_attention(
     q: jax.Array,  # [B, Sq, Hq, D]
     k: jax.Array,  # [B, Sk, Hkv, D]
@@ -72,13 +81,25 @@ def multi_head_attention(
     q: jax.Array, k: jax.Array, v: jax.Array, *,
     causal: bool = False,
     mask: jax.Array | None = None,
+    segment_ids: jax.Array | None = None,   # [B, S] (self-attention)
     softmax_scale: float | None = None,
     impl: str = "xla",
 ) -> jax.Array:
-    """Dispatch between the XLA reference and the Pallas flash kernel."""
+    """Dispatch between the XLA reference and the Pallas flash kernel.
+
+    ``segment_ids`` is the packed-sequence mask (attend within equal ids);
+    the flash path consumes it natively, the XLA path expands it to a
+    boolean mask. General ``mask`` arrays force the XLA path.
+    """
     if impl == "flash" and mask is None:
         from k8s_distributed_deeplearning_tpu.ops import pallas_flash
         return pallas_flash.flash_attention(
-            q, k, v, causal=causal, softmax_scale=softmax_scale)
+            q, k, v, causal=causal, softmax_scale=softmax_scale,
+            q_segment_ids=segment_ids, kv_segment_ids=segment_ids)
+    if segment_ids is not None:
+        seg = segment_mask(segment_ids, segment_ids)
+        mask = seg if mask is None else (
+            mask & seg if mask.dtype == jnp.bool_
+            else mask + jnp.where(seg, 0.0, -jnp.inf))
     return dot_product_attention(q, k, v, causal=causal, mask=mask,
                                  softmax_scale=softmax_scale)

@@ -25,6 +25,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -50,9 +51,13 @@ def _block_sizes(sq: int, sk: int, target: int = 512) -> tuple[int, int]:
 
 # ---------------------------------------------------------------- forward
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
+def _fwd_kernel(q_ref, k_ref, v_ref, *rest,
                 scale: float, causal: bool, block_k: int, seq_k: int,
-                off: int):
+                off: int, segments: bool):
+    if segments:
+        segq_ref, segk_ref, o_ref, lse_ref = rest
+    else:
+        o_ref, lse_ref = rest
     qi = pl.program_id(1)
     block_q = q_ref.shape[1]
     q = q_ref[0].astype(jnp.float32) * scale                    # [bq, d]
@@ -82,6 +87,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
             col = j * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, s.shape, 1)
             s = jnp.where(row + off >= col, s, NEG_INF)
+        if segments:
+            sq_ids = segq_ref[0, 0]                               # [bq]
+            sk_ids = segk_ref[0, 0, pl.ds(j * block_k, block_k)]  # [bk]
+            s = jnp.where(sq_ids[:, None] == sk_ids[None, :], s, NEG_INF)
         bm = jnp.max(s, axis=-1)
         m_new = jnp.maximum(m, bm)
         p = jnp.exp(s - m_new[:, None])
@@ -99,7 +108,21 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
     lse_ref[0, 0] = m + jnp.log(norm)
 
 
-def _fwd(q, k, v, *, causal, scale, interpret):
+def _seg_specs(h: int, block_q: int, sk: int):
+    """BlockSpecs for segment-id arrays on the (b*h, q-blocks) grid.
+
+    Segments ride as [B, 1, S]: TPU block rules constrain the LAST TWO dims
+    (8/128-divisible or full), so a [B, S] layout would make the B dim a
+    "second-last" dim with block 1 — illegal for B not in {1, 8k}. The
+    length-1 middle dim absorbs that constraint (same trick as lse).
+    """
+    return [
+        pl.BlockSpec((1, 1, block_q), lambda g, i: (g // h, 0, i)),
+        pl.BlockSpec((1, 1, sk), lambda g, i: (g // h, 0, 0)),
+    ]
+
+
+def _fwd(q, k, v, segq, segk, *, causal, scale, interpret):
     b, sq, h, d = q.shape
     sk = k.shape[1]
     block_q, block_k = _block_sizes(sq, sk)
@@ -107,17 +130,24 @@ def _fwd(q, k, v, *, causal, scale, interpret):
     qt = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
     kt = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
     vt = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+    segments = segq is not None
 
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                               block_k=block_k, seq_k=sk, off=sk - sq)
+                               block_k=block_k, seq_k=sk, off=sk - sq,
+                               segments=segments)
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda g, i: (g, i, 0)),
+        pl.BlockSpec((1, sk, d), lambda g, i: (g, 0, 0)),
+        pl.BlockSpec((1, sk, d), lambda g, i: (g, 0, 0)),
+    ]
+    operands = [qt, kt, vt]
+    if segments:
+        in_specs += _seg_specs(h, block_q, sk)
+        operands += [segq[:, None, :], segk[:, None, :]]   # [B,1,S] layout
     o, lse = pl.pallas_call(
         kernel,
         grid=(b * h, sq // block_q),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda g, i: (g, i, 0)),
-            pl.BlockSpec((1, sk, d), lambda g, i: (g, 0, 0)),
-            pl.BlockSpec((1, sk, d), lambda g, i: (g, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda g, i: (g, i, 0)),
             # lse rides as [bh, 1, sq]: TPU block rules need the last two dims
@@ -133,15 +163,19 @@ def _fwd(q, k, v, *, causal, scale, interpret):
             bytes_accessed=(qt.size + kt.size + vt.size) * qt.dtype.itemsize,
             transcendentals=b * h * sq * sk),
         interpret=interpret,
-    )(qt, kt, vt)
+    )(*operands)
     return o.reshape(b, h, sq, d).transpose(0, 2, 1, 3), lse
 
 
 # ---------------------------------------------------------------- backward
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
                    scale: float, causal: bool, block_k: int, seq_k: int,
-                   off: int):
+                   off: int, segments: bool):
+    if segments:
+        segq_ref, segk_ref, dq_ref = rest
+    else:
+        (dq_ref,) = rest
     qi = pl.program_id(1)
     block_q = q_ref.shape[1]
     q = q_ref[0].astype(jnp.float32) * scale
@@ -164,6 +198,10 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
             row = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
             col = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
             s = jnp.where(row + off >= col, s, NEG_INF)
+        if segments:
+            sq_ids = segq_ref[0, 0]
+            sk_ids = segk_ref[0, 0, pl.ds(j * block_k, block_k)]
+            s = jnp.where(sq_ids[:, None] == sk_ids[None, :], s, NEG_INF)
         p = jnp.exp(s - lse[:, None])
         p = jnp.where(s <= NEG_INF / 2, 0.0, p)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
@@ -177,10 +215,13 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
     dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, *,
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
                     scale: float, causal: bool, block_q: int, seq_q: int,
-                    off: int):
+                    off: int, segments: bool):
+    if segments:
+        segq_ref, segk_ref, dk_ref, dv_ref = rest
+    else:
+        dk_ref, dv_ref = rest
     ki = pl.program_id(1)
     block_k = k_ref.shape[1]
     k = k_ref[0].astype(jnp.float32)
@@ -207,6 +248,10 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             row = i * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
             col = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
             s = jnp.where(row + off >= col, s, NEG_INF)
+        if segments:
+            sq_ids = segq_ref[0, 0, pl.ds(i * block_q, block_q)]
+            sk_ids = segk_ref[0, 0]
+            s = jnp.where(sq_ids[:, None] == sk_ids[None, :], s, NEG_INF)
         p = jnp.exp(s - lse[:, None])
         p = jnp.where(s <= NEG_INF / 2, 0.0, p)
         dv = dv + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
@@ -225,10 +270,11 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _bwd(causal, scale, interpret, res, g):
-    q, k, v, o, lse = res
+    q, k, v, segq, segk, o, lse = res
     b, sq, h, d = q.shape
     sk = k.shape[1]
     block_q, block_k = _block_sizes(sq, sk)
+    segments = segq is not None
 
     fold = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
     qt, kt, vt, dot = fold(q), fold(k), fold(v), fold(g)
@@ -236,35 +282,50 @@ def _bwd(causal, scale, interpret, res, g):
     delta = jnp.sum(dot.astype(jnp.float32)
                     * fold(o).astype(jnp.float32), axis=-1)[:, None, :]  # [bh,1,sq]
 
+    dq_specs = [
+        pl.BlockSpec((1, block_q, d), lambda g_, i: (g_, i, 0)),
+        pl.BlockSpec((1, sk, d), lambda g_, i: (g_, 0, 0)),
+        pl.BlockSpec((1, sk, d), lambda g_, i: (g_, 0, 0)),
+        pl.BlockSpec((1, block_q, d), lambda g_, i: (g_, i, 0)),
+        pl.BlockSpec((1, 1, block_q), lambda g_, i: (g_, 0, i)),
+        pl.BlockSpec((1, 1, block_q), lambda g_, i: (g_, 0, i)),
+    ]
+    dq_operands = [qt, kt, vt, dot, lse, delta]
+    if segments:
+        dq_specs += _seg_specs(h, block_q, sk)
+        dq_operands += [segq[:, None, :], segk[:, None, :]]
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                          block_k=block_k, seq_k=sk, off=sk - sq),
+                          block_k=block_k, seq_k=sk, off=sk - sq,
+                          segments=segments),
         grid=(b * h, sq // block_q),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda g_, i: (g_, i, 0)),
-            pl.BlockSpec((1, sk, d), lambda g_, i: (g_, 0, 0)),
-            pl.BlockSpec((1, sk, d), lambda g_, i: (g_, 0, 0)),
-            pl.BlockSpec((1, block_q, d), lambda g_, i: (g_, i, 0)),
-            pl.BlockSpec((1, 1, block_q), lambda g_, i: (g_, 0, i)),
-            pl.BlockSpec((1, 1, block_q), lambda g_, i: (g_, 0, i)),
-        ],
+        in_specs=dq_specs,
         out_specs=pl.BlockSpec((1, block_q, d), lambda g_, i: (g_, i, 0)),
         out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
         interpret=interpret,
-    )(qt, kt, vt, dot, lse, delta)
+    )(*dq_operands)
 
+    dkv_specs = [
+        pl.BlockSpec((1, sq, d), lambda g_, j: (g_, 0, 0)),
+        pl.BlockSpec((1, block_k, d), lambda g_, j: (g_, j, 0)),
+        pl.BlockSpec((1, block_k, d), lambda g_, j: (g_, j, 0)),
+        pl.BlockSpec((1, sq, d), lambda g_, j: (g_, 0, 0)),
+        pl.BlockSpec((1, 1, sq), lambda g_, j: (g_, 0, 0)),
+        pl.BlockSpec((1, 1, sq), lambda g_, j: (g_, 0, 0)),
+    ]
+    dkv_operands = [qt, kt, vt, dot, lse, delta]
+    if segments:
+        dkv_specs += [
+            pl.BlockSpec((1, 1, sq), lambda g_, j: (g_ // h, 0, 0)),
+            pl.BlockSpec((1, 1, block_k), lambda g_, j: (g_ // h, 0, j)),
+        ]
+        dkv_operands += [segq[:, None, :], segk[:, None, :]]
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                          block_q=block_q, seq_q=sq, off=sk - sq),
+                          block_q=block_q, seq_q=sq, off=sk - sq,
+                          segments=segments),
         grid=(b * h, sk // block_k),
-        in_specs=[
-            pl.BlockSpec((1, sq, d), lambda g_, j: (g_, 0, 0)),
-            pl.BlockSpec((1, block_k, d), lambda g_, j: (g_, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda g_, j: (g_, j, 0)),
-            pl.BlockSpec((1, sq, d), lambda g_, j: (g_, 0, 0)),
-            pl.BlockSpec((1, 1, sq), lambda g_, j: (g_, 0, 0)),
-            pl.BlockSpec((1, 1, sq), lambda g_, j: (g_, 0, 0)),
-        ],
+        in_specs=dkv_specs,
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda g_, j: (g_, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda g_, j: (g_, j, 0)),
@@ -274,23 +335,30 @@ def _bwd(causal, scale, interpret, res, g):
             jax.ShapeDtypeStruct((b * h, sk, d), v.dtype),
         ],
         interpret=interpret,
-    )(qt, kt, vt, dot, lse, delta)
+    )(*dkv_operands)
 
     unfold = lambda x, s: x.reshape(b, h, s, d).transpose(0, 2, 1, 3)
-    return unfold(dq, sq), unfold(dk, sk), unfold(dv, sk)
+    none_seg = None if segq is None else np.zeros(segq.shape,
+                                                  jax.dtypes.float0)
+    none_segk = None if segk is None else np.zeros(segk.shape,
+                                                   jax.dtypes.float0)
+    return (unfold(dq, sq), unfold(dk, sk), unfold(dv, sk),
+            none_seg, none_segk)
 
 
 # ---------------------------------------------------------------- public API
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _flash(q, k, v, causal, scale, interpret):
-    o, _ = _fwd(q, k, v, causal=causal, scale=scale, interpret=interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
+def _flash(q, k, v, segq, segk, causal, scale, interpret):
+    o, _ = _fwd(q, k, v, segq, segk, causal=causal, scale=scale,
+                interpret=interpret)
     return o
 
 
-def _flash_fwd(q, k, v, causal, scale, interpret):
-    o, lse = _fwd(q, k, v, causal=causal, scale=scale, interpret=interpret)
-    return o, (q, k, v, o, lse)
+def _flash_fwd(q, k, v, segq, segk, causal, scale, interpret):
+    o, lse = _fwd(q, k, v, segq, segk, causal=causal, scale=scale,
+                  interpret=interpret)
+    return o, (q, k, v, segq, segk, o, lse)
 
 
 _flash.defvjp(_flash_fwd,
@@ -301,18 +369,38 @@ _flash.defvjp(_flash_fwd,
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                     causal: bool = False,
                     softmax_scale: float | None = None,
+                    q_segment_ids: jax.Array | None = None,
+                    kv_segment_ids: jax.Array | None = None,
                     interpret: bool | None = None) -> jax.Array:
     """Flash attention, [B,S,H,D] layout, GQA via KV-head repeat.
+
+    ``q_segment_ids``/``kv_segment_ids`` ([B, S] int32) restrict attention to
+    equal segment ids — the packed-sequence mask (multiple documents per row)
+    and, with a sentinel id on pad positions, the padding mask. Composes with
+    ``causal``. Both must be given together.
 
     ``interpret=None`` auto-selects: compiled on TPU, interpreter elsewhere
     (CPU CI runs the same kernels). Sequence lengths must be divisible by the
     chosen power-of-two block sizes (always true for the usual 2^k lengths).
     """
     from k8s_distributed_deeplearning_tpu.ops.attention import _repeat_kv
+    if (q_segment_ids is None) != (kv_segment_ids is None):
+        raise ValueError("q_segment_ids and kv_segment_ids must be given "
+                         "together")
+    if q_segment_ids is not None:
+        if q_segment_ids.shape != q.shape[:2]:
+            raise ValueError(f"q_segment_ids {q_segment_ids.shape} must be "
+                             f"[B, Sq] = {q.shape[:2]}")
+        if kv_segment_ids.shape != k.shape[:2]:
+            raise ValueError(f"kv_segment_ids {kv_segment_ids.shape} must be "
+                             f"[B, Sk] = {k.shape[:2]}")
+        q_segment_ids = q_segment_ids.astype(jnp.int32)
+        kv_segment_ids = kv_segment_ids.astype(jnp.int32)
     hq = q.shape[2]
     k = _repeat_kv(k, hq)
     v = _repeat_kv(v, hq)
     scale = softmax_scale if softmax_scale is not None else q.shape[-1] ** -0.5
     if interpret is None:
         interpret = not _on_tpu()
-    return _flash(q, k, v, causal, scale, interpret)
+    return _flash(q, k, v, q_segment_ids, kv_segment_ids, causal, scale,
+                  interpret)

@@ -120,9 +120,10 @@ def interconnect_bandwidth_estimate() -> float:
             "tpu v5p": 1.2e11,
             "tpu v6": 1.8e11,
         }
-        for key, val in table.items():
+        # Longest key first: "tpu v5" would otherwise shadow "tpu v5p".
+        for key in sorted(table, key=len, reverse=True):
             if key in kind:
-                return val
+                return table[key]
         return 9e10
     from k8s_distributed_deeplearning_tpu.runtime.fusion import (
         probe_memcpy_bandwidth)
@@ -146,7 +147,9 @@ def peak_flops_per_device(dtype: str = "bfloat16") -> float:
         "tpu v6 lite": 918e12,
         "tpu v6e": 918e12,
     }
-    for key, val in table.items():
+    # Longest key first: "tpu v5" would otherwise shadow "tpu v5p" etc.
+    for key in sorted(table, key=len, reverse=True):
         if key in kind:
+            val = table[key]
             return val if dtype == "bfloat16" else val / 2
     return 1e11
