@@ -163,7 +163,7 @@ def main(argv: list[str] | None = None) -> dict:
     trainer = sharding.ShardedTrainer(loss, optimizer, mesh)
     init = lambda r: model.init(r, jnp.zeros((1, 8), jnp.int32))["params"]
     state = trainer.init(init, jax.random.key(conf.seed))
-    step_fn = trainer.make_step(donate=True)
+    step_fn = trainer.make_step(donate=True, microbatches=conf.grad_accum)
 
     tokens = data_lib.load_tokens(args.data_path,
                                   vocab_size=model_cfg.vocab_size,

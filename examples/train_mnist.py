@@ -79,7 +79,7 @@ def main(argv: list[str] | None = None) -> dict:
 
     step_fn = dp.make_train_step(
         lambda p, b, r: mnist.loss_fn(model, p, b, r),
-        optimizer, mesh, reduction=reduction)
+        optimizer, mesh, reduction=reduction, microbatches=conf.grad_accum)
 
     images, labels = data_lib.load_or_synthesize(conf.data_dir, "train",
                                                  seed=conf.seed)

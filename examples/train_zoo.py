@@ -211,7 +211,7 @@ def main(argv: list[str] | None = None) -> dict:
 
         trainer = sharding.ShardedTrainer(loss, optimizer, mesh)
         state = trainer.init(init, rng)
-        step_fn = trainer.make_step(donate=True)
+        step_fn = trainer.make_step(donate=True, microbatches=conf.grad_accum)
 
         def global_batches(start):
             return _maybe_prefetch(batcher.iter_from(start),

@@ -42,6 +42,11 @@ class TrainConfig:
     # Data
     data_dir: str | None = None      # MNIST idx files; None -> synthetic
 
+    # Gradient accumulation: microbatches per optimizer step (1 = off).
+    # batch_size stays the per-replica batch the optimizer sees; each
+    # microbatch is batch_size // grad_accum examples.
+    grad_accum: int = 1
+
     def scaled_lr(self, world_size: int, local_size: int = 1,
                   fast_interconnect: bool = False) -> float:
         """Horovod LR scaling rule (``tensorflow_mnist.py:123-130``).
@@ -148,6 +153,9 @@ def add_train_flags(parser: argparse.ArgumentParser,
     # 1.0 via parser.set_defaults — standard pretraining hygiene there.
     parser.add_argument("--grad-clip", type=float, default=0.0,
                         help="global-norm gradient clip (0 disables)")
+    parser.add_argument("--grad-accum", type=int, default=d.grad_accum,
+                        help="microbatches accumulated per optimizer step "
+                             "(1 = off); batch-size must divide evenly")
 
 
 def train_config_from_args(args: argparse.Namespace) -> TrainConfig:

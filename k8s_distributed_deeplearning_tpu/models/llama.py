@@ -35,11 +35,17 @@ class LlamaLM(nn.Module):
                  segment_ids: jax.Array | None = None,
                  deterministic: bool = True,
                  attention_fn=None,
-                 decode: bool = False) -> jax.Array:
+                 decode: bool = False,
+                 return_hidden: bool = False) -> jax.Array:
         x = Transformer(self.cfg, name="transformer")(
             tokens, positions=positions, segment_ids=segment_ids,
             deterministic=deterministic,
             attention_fn=attention_fn, decode=decode)
+        if return_hidden:
+            # Final hidden states for a chunked LM-head loss
+            # (ops/chunked_ce.py). Only valid at apply time: init must take
+            # the default path so LMHead params get created.
+            return x
         embedding = None
         if self.cfg.tie_embeddings:
             embedding = self.variables["params"]["transformer"]["tok_embed"]["embedding"]

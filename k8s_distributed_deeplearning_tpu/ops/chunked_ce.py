@@ -1,0 +1,85 @@
+"""Memory-efficient (chunked) softmax cross-entropy for large vocabularies.
+
+The naive LM loss materializes f32 logits of shape ``[B, S, V]`` — for
+Llama-3 8B shapes (V=128256, S=8192) that is ~4 GiB *per example per batch
+element*, usually the single largest activation in the step. The TPU-native
+fix: scan over sequence chunks, computing each chunk's logits on the MXU,
+reducing them to per-chunk loss sums, and letting ``jax.checkpoint`` recompute
+the chunk logits in the backward pass instead of storing them. Peak logits
+memory drops from ``S×V`` to ``chunk×V`` at the cost of one extra head matmul
+in the backward — the classic remat trade, applied at the op level.
+
+No reference analog (the reference's output layer is 10 classes,
+``horovod/tensorflow_mnist.py:66-71``); this exists for the BASELINE.json
+large-model configs.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+
+
+def chunked_softmax_cross_entropy(
+    x: jax.Array,
+    w: jax.Array,
+    targets: jax.Array,
+    mask: jax.Array | None = None,
+    *,
+    chunk_size: int = 1024,
+    w_layout: str = "dv",
+    compute_dtype=None,
+) -> tuple[jax.Array, jax.Array]:
+    """Masked-mean next-token CE without materializing full-sequence logits.
+
+    Args:
+      x: ``[B, S, D]`` final hidden states (compute dtype).
+      w: unembedding matrix — ``[D, V]`` (``w_layout="dv"``, the untied
+        ``lm_head`` kernel) or ``[V, D]`` (``w_layout="vd"``, a tied input
+        embedding table).
+      targets: ``[B, S]`` int target ids.
+      mask: ``[B, S]`` float, 1.0 = position counts. None = all count.
+      chunk_size: sequence positions per scanned chunk.
+      compute_dtype: dtype for the head matmul inputs (defaults to x.dtype);
+        accumulation is always f32 via ``preferred_element_type``.
+
+    Returns:
+      ``(loss, accuracy)`` — masked means, f32 scalars.
+    """
+    if w_layout not in ("dv", "vd"):
+        raise ValueError(f"w_layout must be 'dv' or 'vd', got {w_layout!r}")
+    B, S, D = x.shape
+    dtype = compute_dtype or x.dtype
+    w = w.astype(dtype)
+    if mask is None:
+        mask = jnp.ones((B, S), jnp.float32)
+    mask = mask.astype(jnp.float32)
+
+    chunk_size = min(chunk_size, S)
+    n_chunks = -(-S // chunk_size)
+    pad = n_chunks * chunk_size - S
+    if pad:
+        x = jnp.pad(x, ((0, 0), (0, pad), (0, 0)))
+        targets = jnp.pad(targets, ((0, 0), (0, pad)))
+        mask = jnp.pad(mask, ((0, 0), (0, pad)))  # pad positions masked out
+
+    # [n, B, C, ...] scan layout.
+    split = lambda t: t.reshape((B, n_chunks, chunk_size) + t.shape[2:]
+                                ).swapaxes(0, 1)
+    xs, ts, ms = split(x.astype(dtype)), split(targets), split(mask)
+
+    eq = "bcd,dv->bcv" if w_layout == "dv" else "bcd,vd->bcv"
+
+    def body(carry, inp):
+        xc, tc, mc = inp
+        logits = jnp.einsum(eq, xc, w, preferred_element_type=jnp.float32)
+        ce = optax.softmax_cross_entropy_with_integer_labels(logits, tc)
+        correct = (logits.argmax(-1) == tc).astype(jnp.float32)
+        ce_sum, corr_sum = carry
+        return (ce_sum + (ce * mc).sum(), corr_sum + (correct * mc).sum()), None
+
+    init = (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32))
+    (ce_sum, corr_sum), _ = lax.scan(jax.checkpoint(body), init, (xs, ts, ms))
+    denom = jnp.maximum(mask.sum(), 1.0)
+    return ce_sum / denom, corr_sum / denom

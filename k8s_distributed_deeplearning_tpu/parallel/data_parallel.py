@@ -84,6 +84,63 @@ def reduce_gradients(grads: PyTree, axis_name: str, axis_size: int,
     raise ValueError(f"unknown reduction {reduction}")
 
 
+def accumulate_gradients(loss_fn: LossFn, params: PyTree, batch: PyTree,
+                         rng: jax.Array, microbatches: int,
+                         constrain: Callable[[PyTree], PyTree] | None = None):
+    """Gradient accumulation: split *batch* into equal microbatches along the
+    leading axis, ``lax.scan`` the value-and-grad over them, and return
+    microbatch-averaged ``((loss, aux), grads)`` — numerically the same step
+    as one big batch (for mean-reduced losses) at 1/``microbatches`` the
+    activation memory. The scan is sequential per device, so XLA keeps one
+    microbatch of activations live at a time.
+
+    The reference has no analog (its global batch is 200 images); this exists
+    for the large-model configs where the per-device batch that fits in HBM is
+    smaller than the batch the optimizer wants.
+
+    *constrain*, if given, is applied to the split ``[microbatches, B/m, ...]``
+    tree — under ``jit`` with sharding propagation (ShardedTrainer) it pins the
+    microbatch dim replicated and the batch dim sharded, so every device works
+    on every microbatch (one cheap input all-to-all instead of a skewed
+    layout). The explicit shard_map path doesn't need it (the split is local).
+    """
+    import jax.numpy as jnp
+
+    if microbatches <= 1:
+        return jax.value_and_grad(loss_fn, has_aux=True)(params, batch, rng)
+
+    def split(x):
+        if x.shape[0] % microbatches:
+            raise ValueError(
+                f"batch axis {x.shape[0]} not divisible by "
+                f"microbatches={microbatches}")
+        return x.reshape((microbatches, x.shape[0] // microbatches)
+                         + x.shape[1:])
+
+    mb = jax.tree.map(split, batch)
+    if constrain is not None:
+        mb = constrain(mb)
+    rngs = jax.random.split(rng, microbatches)
+
+    def one(mb_batch, r):
+        return jax.value_and_grad(loss_fn, has_aux=True)(params, mb_batch, r)
+
+    shapes = jax.eval_shape(one, jax.tree.map(lambda x: x[0], mb), rngs[0])
+    zeros = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes)
+
+    def body(acc, xs):
+        mb_batch, r = xs
+        (loss, aux), grads = one(mb_batch, r)
+        (l_acc, a_acc), g_acc = acc
+        return ((l_acc + loss, jax.tree.map(jnp.add, a_acc, aux)),
+                jax.tree.map(jnp.add, g_acc, grads)), None
+
+    ((loss, aux), grads), _ = lax.scan(body, zeros, (mb, rngs))
+    inv = 1.0 / microbatches
+    scale = lambda t: jax.tree.map(lambda x: x * inv, t)
+    return (loss * inv, scale(aux)), scale(grads)
+
+
 class TrainState(NamedTuple):
     """Minimal DP train state: params + optimizer state + step counter."""
 
@@ -112,6 +169,7 @@ def make_train_step(
     axis_name: str = "data",
     reduction: Reduction = Reduction.AVERAGE,
     bucket_bytes: "int | str | None" = None,
+    microbatches: int = 1,
 ) -> Callable[[TrainState, PyTree, jax.Array], tuple[TrainState, jax.Array, Any]]:
     """Build the jitted synchronous-DP train step.
 
@@ -120,6 +178,8 @@ def make_train_step(
     is globally-batched (leading axis = global batch) and sharded over
     ``axis_name``; loss and aux come back averaged across replicas (aux parity:
     ``MetricAverageCallback``, ``tensorflow_mnist_gpu.py:153``).
+    ``microbatches`` > 1 accumulates gradients over that many sequential
+    microbatches of the per-replica shard before the (single) allreduce.
     """
     axis_size = mesh.shape[axis_name]
 
@@ -127,8 +187,8 @@ def make_train_step(
         # Per-replica RNG (dropout etc.): fold in the replica id so ranks
         # draw independent masks, like per-rank TF seeds in the reference.
         rng = jax.random.fold_in(rng, lax.axis_index(axis_name))
-        (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            state.params, batch, rng)
+        (loss, aux), grads = accumulate_gradients(
+            loss_fn, state.params, batch, rng, microbatches)
         grads = reduce_gradients(grads, axis_name, axis_size, reduction,
                                  bucket_bytes=bucket_bytes)
         loss = lax.pmean(loss, axis_name)

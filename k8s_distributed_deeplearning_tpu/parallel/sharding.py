@@ -157,15 +157,31 @@ class ShardedTrainer:
                 jax.eval_shape(lambda: state), self.mesh, self.rules)
         return self._state_sh
 
-    def make_step(self, donate: bool = True) -> Callable:
-        """Jitted step(state, batch, rng) -> (state, loss, aux)."""
+    def make_step(self, donate: bool = True, microbatches: int = 1) -> Callable:
+        """Jitted step(state, batch, rng) -> (state, loss, aux).
+
+        ``microbatches`` > 1 turns on gradient accumulation: the global batch
+        is split along its leading axis and scanned sequentially, trading step
+        latency for 1/N activation memory (the XLA collectives FSDP/TP insert
+        run per microbatch; the optimizer update stays once per step).
+        """
+        from k8s_distributed_deeplearning_tpu.parallel.data_parallel import (
+            accumulate_gradients)
+
         rules, mesh, opt = self.rules, self.mesh, self.optimizer
         loss_fn = self.loss_fn
 
+        batch_target = dict(rules).get("batch")
+        mb_sh = NamedSharding(mesh, P(None, batch_target))
+
+        def constrain(tree: PyTree) -> PyTree:
+            return jax.lax.with_sharding_constraint(tree, mb_sh)
+
         def step(state: TrainState, batch: PyTree, rng: jax.Array):
             with nn.logical_axis_rules(rules):  # trace-time rule context
-                (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-                    state.params, batch, rng)
+                (loss, aux), grads = accumulate_gradients(
+                    loss_fn, state.params, batch, rng, microbatches,
+                    constrain=constrain if microbatches > 1 else None)
                 updates, opt_state = opt.update(grads, state.opt_state,
                                                 state.params)
                 params = optax.apply_updates(state.params, updates)

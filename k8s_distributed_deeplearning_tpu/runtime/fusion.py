@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import ctypes
 import os
+import subprocess
 from dataclasses import dataclass
 
 import numpy as np
@@ -21,7 +22,24 @@ _NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
     os.path.dirname(os.path.abspath(__file__)))), "native")
 
 
+def _ensure_built() -> None:
+    """Build the native library if missing/stale and the source tree + make
+    are available (no-op for installed wheels without the native dir)."""
+    src = os.path.join(_NATIVE_DIR, "fusion.cc")
+    so = os.path.join(_NATIVE_DIR, _LIB_NAME)
+    if not os.path.exists(src):
+        return
+    if os.path.exists(so) and os.path.getmtime(so) >= os.path.getmtime(src):
+        return
+    try:
+        subprocess.run(["make", "-C", _NATIVE_DIR], check=False,
+                       capture_output=True, timeout=120)
+    except (OSError, subprocess.TimeoutExpired):
+        pass
+
+
 def _load() -> ctypes.CDLL | None:
+    _ensure_built()
     for candidate in (os.environ.get("TPU_RUNTIME_LIB"),
                       os.path.join(_NATIVE_DIR, _LIB_NAME)):
         if candidate and os.path.exists(candidate):

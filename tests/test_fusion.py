@@ -1,5 +1,4 @@
 """Native runtime: fusion planner, autotuner, probe, bucketed reduction."""
-import subprocess
 import sys
 
 import numpy as np
@@ -11,10 +10,9 @@ from k8s_distributed_deeplearning_tpu.runtime import fusion
 
 def test_native_library_builds_and_loads():
     # The native core is a product requirement (Horovod C++ parity); the repo
-    # ships the toolchain, so the .so must build and load here.
-    subprocess.run(["make", "-C", "native", "-q"], cwd=fusion._NATIVE_DIR + "/..",
-                   check=False)
-    assert fusion.native_available(), "libtpu_runtime.so not built — run make -C native"
+    # ships the toolchain, so the .so must build (on demand, in the loader)
+    # and load here.
+    assert fusion.native_available(), "libtpu_runtime.so failed to build/load"
 
 
 def test_plan_respects_threshold():

@@ -1,0 +1,96 @@
+"""Gradient accumulation: microbatched steps must equal the one-big-batch
+step (for mean-reduced losses) in both engines, and error on bad splits."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from k8s_distributed_deeplearning_tpu.parallel import data_parallel as dp
+from k8s_distributed_deeplearning_tpu.parallel import sharding
+from tests.test_data_parallel import _batch, quad_loss
+
+
+def test_accumulate_matches_full_batch():
+    params = {"w": jnp.ones((4, 2)), "b": jnp.zeros((2,))}
+    batch = _batch(32)
+    rng = jax.random.key(0)
+    (ref_loss, ref_aux), ref_grads = jax.value_and_grad(
+        quad_loss, has_aux=True)(params, batch, rng)
+    (loss, aux), grads = dp.accumulate_gradients(quad_loss, params, batch,
+                                                 rng, microbatches=4)
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+    np.testing.assert_allclose(float(aux["mae"]), float(ref_aux["mae"]),
+                               rtol=1e-5)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5,
+                                                         atol=1e-6),
+                 grads, ref_grads)
+
+
+def test_accumulate_rejects_uneven_split():
+    params = {"w": jnp.ones((4, 2)), "b": jnp.zeros((2,))}
+    with pytest.raises(ValueError, match="not divisible"):
+        dp.accumulate_gradients(quad_loss, params, _batch(10), jax.random.key(0),
+                                microbatches=4)
+
+
+def test_dp_step_with_microbatches_matches_plain(mesh8):
+    opt = optax.sgd(0.1)
+    params = {"w": jnp.ones((4, 2)), "b": jnp.zeros((2,))}
+    batch = _batch(32)
+    rng = jax.random.key(0)
+
+    plain = dp.make_train_step(quad_loss, opt, mesh8)
+    accum = dp.make_train_step(quad_loss, opt, mesh8, microbatches=2)
+
+    s1 = dp.init_state(dp.replicate(params, mesh8), opt, mesh8)
+    s1, loss1, _ = plain(s1, batch, rng)
+    s2 = dp.init_state(dp.replicate(params, mesh8), opt, mesh8)
+    s2, loss2, _ = accum(s2, batch, rng)
+
+    np.testing.assert_allclose(float(loss1), float(loss2), rtol=1e-5)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5,
+                                                         atol=1e-6),
+                 s1.params, s2.params)
+
+
+def test_sharded_trainer_microbatches():
+    """ShardedTrainer grad accumulation under real dp+fsdp+tensor sharding."""
+    from k8s_distributed_deeplearning_tpu.models import llama
+    from k8s_distributed_deeplearning_tpu.parallel import mesh as mesh_lib
+
+    mesh = mesh_lib.make_mesh({"data": 2, "fsdp": 2, "tensor": 2})
+    cfg = llama.config_tiny(dim=32, n_layers=2, n_heads=4, n_kv_heads=4,
+                            vocab=64, dtype=jnp.float32)
+    model = llama.LlamaLM(cfg)
+
+    def loss(params, batch, rng):
+        del rng
+        toks = batch["tokens"]
+        logits = model.apply({"params": params}, toks[:, :-1],
+                             deterministic=True)
+        ce = optax.softmax_cross_entropy_with_integer_labels(
+            logits, toks[:, 1:]).mean()
+        return ce, {}
+
+    opt = optax.sgd(0.1)
+    toks = np.random.default_rng(0).integers(0, 64, size=(8, 17),
+                                             dtype=np.int32)
+    batch = {"tokens": toks}
+    rng = jax.random.key(0)
+
+    tr1 = sharding.ShardedTrainer(loss, opt, mesh)
+    init = lambda r: model.init(r, jnp.zeros((1, 8), jnp.int32))["params"]
+    st1 = tr1.init(init, jax.random.key(1))
+    st1, loss1, _ = tr1.make_step(donate=False)(st1, tr1.shard_batch(batch),
+                                                rng)
+
+    tr2 = sharding.ShardedTrainer(loss, opt, mesh)
+    st2 = tr2.init(init, jax.random.key(1))
+    st2, loss2, _ = tr2.make_step(donate=False, microbatches=4)(
+        st2, tr2.shard_batch(batch), rng)
+
+    np.testing.assert_allclose(float(loss1), float(loss2), rtol=1e-5)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=2e-4, atol=1e-5),
+        sharding.unbox(st1.params), sharding.unbox(st2.params))
