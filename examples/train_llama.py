@@ -29,7 +29,6 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 import jax.numpy as jnp
-import optax
 
 from k8s_distributed_deeplearning_tpu import config as cfg
 from k8s_distributed_deeplearning_tpu.models import llama
@@ -96,6 +95,12 @@ def main(argv: list[str] | None = None) -> dict:
                         help="checkpoint each block (long-context memory lever)")
     parser.add_argument("--data-path", type=str, default=None,
                         help="byte-level corpus file; default synthetic tokens")
+    parser.add_argument("--chunked-ce", dest="chunked_ce", action="store_true",
+                        default=None,
+                        help="chunked LM-head loss (never materializes "
+                        "[B,S,V] logits); default: on for --preset 8b")
+    parser.add_argument("--no-chunked-ce", dest="chunked_ce",
+                        action="store_false")
     parser.add_argument("--optimizer", choices=optim.OPTIMIZERS,
                         default="adamw")
     parser.add_argument("--schedule", choices=optim.SCHEDULES,
@@ -140,16 +145,14 @@ def main(argv: list[str] | None = None) -> dict:
         attention_fn = cp.make_context_parallel_attention(
             mesh, cp_impl, inner_impl=cp_inner)
 
+    # Chunked CE defaults on for the 8B preset, where the [B,S,V] logits
+    # tensor (V=128256) is the single largest activation in the step.
+    chunked = (args.chunked_ce if args.chunked_ce is not None
+               else args.preset == "8b")
+
     def loss(params, batch, rng):
-        toks = batch["tokens"]
-        inputs, targets = toks[:, :-1], toks[:, 1:]
-        rngs = {"dropout": rng} if rng is not None else None
-        logits = model.apply({"params": params}, inputs,
-                             deterministic=rng is None, rngs=rngs,
-                             attention_fn=attention_fn)
-        ce = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
-        acc = (logits.argmax(-1) == targets).mean()
-        return ce.mean(), {"accuracy": acc, "perplexity": jnp.exp(ce.mean())}
+        return llama.loss_fn(model, params, batch, rng,
+                             attention_fn=attention_fn, chunked=chunked)
 
     # LM convention: --num-steps is the optimizer-step budget as given (the
     # reference's steps//world rule, tensorflow_mnist.py:146, presumes a fixed

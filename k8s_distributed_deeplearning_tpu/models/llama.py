@@ -49,6 +49,10 @@ class LlamaLM(nn.Module):
         embedding = None
         if self.cfg.tie_embeddings:
             embedding = self.variables["params"]["transformer"]["tok_embed"]["embedding"]
+            if hasattr(embedding, "unbox"):
+                # Raw self.variables access bypasses flax's transparent
+                # unboxing of nn.Partitioned/LogicallyPartitioned leaves.
+                embedding = embedding.unbox()
         return LMHead(self.cfg, name="head")(x, embedding)
 
 
@@ -71,29 +75,68 @@ def config_tiny(**overrides) -> TransformerConfig:
     return TransformerConfig(**base)
 
 
-def loss_fn(model: LlamaLM, params, batch, rng=None) -> tuple[jax.Array, dict]:
+def unembedding(cfg: TransformerConfig, params) -> tuple[jax.Array, str]:
+    """The LM-head weight and its layout for the chunked-CE kernel: the
+    ``lm_head`` kernel ``[D, V]`` ("dv") when untied, the input embedding
+    table ``[V, D]`` ("vd") when tied. Handles boxed (``nn.Partitioned``)
+    and plain leaves — ShardedTrainer losses see boxed params."""
+    if cfg.tie_embeddings:
+        w = params["transformer"]["tok_embed"]["embedding"]
+        layout = "vd"
+    else:
+        w = params["head"]["lm_head"]["kernel"]
+        layout = "dv"
+    if hasattr(w, "unbox"):
+        w = w.unbox()
+    return w, layout
+
+
+def loss_fn(model: LlamaLM, params, batch, rng=None, *,
+            attention_fn=None, chunked: bool = False,
+            chunk_size: int = 1024) -> tuple[jax.Array, dict]:
     """Next-token cross-entropy. ``batch``: {"tokens": [B,S] int32, optional
     "mask": [B,S] 1.0 = count this position, optional "segment_ids": [B,S]
     int32 packed-document ids (attention stays within a document, and
     cross-document boundary positions don't count toward the loss)}.
-    Shifts internally: position i predicts token i+1."""
+    Shifts internally: position i predicts token i+1.
+
+    ``chunked=True`` routes through :func:`ops.chunked_ce
+    .chunked_softmax_cross_entropy`: the model returns final hidden states
+    (``return_hidden``) and the LM-head matmul + CE run per sequence chunk
+    under remat, so the full ``[B, S, V]`` logits tensor is never
+    materialized — the memory lever that lets the 8B config's 128k vocab fit.
+    Numerics match the unchunked path exactly at f32; at bf16 the chunked
+    path is at least as accurate (its head matmul accumulates in f32 via
+    ``preferred_element_type`` where ``LMHead`` emits bf16 then upcasts).
+    """
     tokens = batch["tokens"]
     inputs, targets = tokens[:, :-1], tokens[:, 1:]
     seg = batch.get("segment_ids")
     rngs = {"dropout": rng} if rng is not None else None
     seg_in = None if seg is None else seg[:, :-1]
-    logits = model.apply(
-        {"params": params}, inputs,
+    apply_kw = dict(
         segment_ids=seg_in,
         # RoPE positions restart per packed document — without this, packed
         # training silently diverges from training the documents unpacked.
         positions=None if seg_in is None else packed_positions(seg_in),
-        deterministic=rng is None, rngs=rngs)
+        deterministic=rng is None, rngs=rngs, attention_fn=attention_fn)
     mask = batch.get("mask")
     mask = jnp.ones_like(targets, jnp.float32) if mask is None else mask[:, 1:]
     if seg is not None:
         # Position i predicts i+1: only count pairs inside one document.
         mask = mask * (seg[:, :-1] == seg[:, 1:]).astype(jnp.float32)
+
+    if chunked:
+        from k8s_distributed_deeplearning_tpu.ops.chunked_ce import (
+            chunked_softmax_cross_entropy)
+        hidden = model.apply({"params": params}, inputs,
+                             return_hidden=True, **apply_kw)
+        w, layout = unembedding(model.cfg, params)
+        loss, acc = chunked_softmax_cross_entropy(
+            hidden, w, targets, mask, chunk_size=chunk_size, w_layout=layout)
+        return loss, {"accuracy": acc, "perplexity": jnp.exp(loss)}
+
+    logits = model.apply({"params": params}, inputs, **apply_kw)
     ce = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
     loss = (ce * mask).sum() / jnp.maximum(mask.sum(), 1.0)
     acc = (((logits.argmax(-1) == targets) * mask).sum()

@@ -22,6 +22,7 @@ parity with Horovod's C++ core.
 from __future__ import annotations
 
 import enum
+import functools as _functools
 from typing import Any, Callable, NamedTuple
 
 import jax
@@ -149,17 +150,40 @@ class TrainState(NamedTuple):
     step: jax.Array
 
 
+@_functools.lru_cache(maxsize=None)
+def _identity_jit(sharding: NamedSharding | None):
+    # One cached executable per target sharding: a fresh lambda per call
+    # would defeat the jit cache and recompile on every placement.
+    if sharding is None:
+        return jax.jit(lambda t: t)
+    return jax.jit(lambda t: t, out_shardings=sharding)
+
+
+def _fresh_put(tree: PyTree, sharding: NamedSharding | None = None) -> PyTree:
+    """Place *tree* (on *sharding*, if given) with guaranteed-fresh buffers.
+
+    ``jax.device_put`` may alias zero-copy when source and target placement
+    already match (common on the CPU backend), and the train step donates its
+    state — an aliased placement would let donation delete the *caller's*
+    arrays. A non-donating jitted identity always materializes new output
+    buffers, so the result is safe to hand to a donating step while the
+    caller keeps using its own tree.
+    """
+    return _identity_jit(sharding)(tree)
+
+
 def init_state(params: PyTree, optimizer: optax.GradientTransformation,
                mesh: Mesh | None = None) -> TrainState:
-    """Build the initial TrainState; with *mesh*, place every leaf (params,
-    optimizer state, step counter) fully-replicated so checkpoint restore and
-    the jitted step see one consistent sharding."""
+    """Build the initial TrainState — freshly copied (with or without a
+    mesh), so the donating train step can never invalidate the caller's
+    ``params``. With *mesh*, every leaf (params, optimizer state, step
+    counter) is additionally placed fully-replicated so checkpoint restore
+    and the jitted step see one consistent sharding."""
     import jax.numpy as jnp
     state = TrainState(params=params, opt_state=optimizer.init(params),
                        step=jnp.zeros((), jnp.int32))
-    if mesh is not None:
-        state = jax.device_put(state, NamedSharding(mesh, P()))
-    return state
+    sharding = None if mesh is None else NamedSharding(mesh, P())
+    return _fresh_put(state, sharding)
 
 
 def make_train_step(
@@ -247,9 +271,9 @@ def broadcast_params(params: PyTree, mesh: Mesh, axis_name: str = "data",
 
 
 def replicate(tree: PyTree, mesh: Mesh) -> PyTree:
-    """Place *tree* fully-replicated on the mesh."""
-    sharding = NamedSharding(mesh, P())
-    return jax.device_put(tree, sharding)
+    """Place *tree* fully-replicated on the mesh, as a fresh copy (never an
+    alias of the input's buffers — see :func:`_fresh_put`)."""
+    return _fresh_put(tree, NamedSharding(mesh, P()))
 
 
 def shard_batch(batch: PyTree, mesh: Mesh, axis_name: str = "data") -> PyTree:

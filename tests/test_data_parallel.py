@@ -82,6 +82,26 @@ def test_broadcast_params(mesh8):
     jax.tree.map(lambda a, b: np.testing.assert_allclose(a, b), out, params)
 
 
+def test_params_survive_donating_steps(mesh8):
+    """Regression: replicate/init_state must copy, not alias — a donating
+    step must never delete the caller's params tree, so one tree can seed
+    multiple step functions (the round-1 'Array has been deleted' footgun)."""
+    params = {"w": jnp.ones((4, 2)), "b": jnp.zeros((2,))}
+    opt = optax.sgd(0.1)
+    batch = _batch(16)
+    for microbatches in (1, 2):
+        step = dp.make_train_step(quad_loss, opt, mesh8,
+                                  microbatches=microbatches)
+        state = dp.init_state(dp.replicate(params, mesh8), opt, mesh8)
+        state, loss, _ = step(state, batch, jax.random.key(0))
+        assert np.isfinite(float(loss))
+    # Original tree is intact and still usable after two donated steps.
+    np.testing.assert_allclose(np.asarray(params["w"]), 1.0)
+    state = dp.init_state(params, opt, mesh8)  # direct, no replicate()
+    step(state, batch, jax.random.key(1))
+    np.testing.assert_allclose(np.asarray(params["b"]), 0.0)
+
+
 def test_lr_and_step_scaling_rules():
     """tensorflow_mnist.py:123-130,146 parity."""
     c = TrainConfig(lr=0.001, num_steps=20000)

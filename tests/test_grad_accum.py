@@ -61,7 +61,7 @@ def test_sharded_trainer_microbatches():
 
     mesh = mesh_lib.make_mesh({"data": 2, "fsdp": 2, "tensor": 2})
     cfg = llama.config_tiny(dim=32, n_layers=2, n_heads=4, n_kv_heads=4,
-                            vocab=64, dtype=jnp.float32)
+                            vocab_size=64, dtype=jnp.float32)
     model = llama.LlamaLM(cfg)
 
     def loss(params, batch, rng):
