@@ -89,6 +89,11 @@ def main(argv: list[str] | None = None) -> dict:
     parser.add_argument("--tp", type=int, default=1)
     parser.add_argument("--sp", type=int, default=1,
                         help="sequence-parallel axis (ring attention)")
+    parser.add_argument("--pp", type=int, default=1,
+                        help="pipeline-parallel stages (GPipe over the "
+                        "scan-stacked layers; composes with --dp only)")
+    parser.add_argument("--pp-microbatches", type=int, default=None,
+                        help="pipeline microbatches (default: --pp)")
     parser.add_argument("--attention", choices=["xla", "flash", "ring", "ulysses"],
                         default="xla")
     parser.add_argument("--remat", action="store_true",
@@ -114,13 +119,22 @@ def main(argv: list[str] | None = None) -> dict:
 
     distributed.initialize_from_env()
     topo = mesh_lib.topology()
+    use_pp = args.pp > 1
     use_cp = args.sp > 1 or args.attention in ("ring", "ulysses")
-    # Context-parallel shard_map specs name the "sequence" axis, so keep it
-    # in the mesh even at size 1 when CP attention is requested.
-    mesh = mesh_lib.make_mesh(cfg.MeshConfig(
-        data=args.dp, fsdp=args.fsdp, tensor=args.tp,
-        sequence=args.sp).to_axis_sizes(
-            keep=("sequence",) if use_cp else ()))
+    if use_pp and (args.fsdp > 1 or args.tp > 1 or use_cp):
+        raise ValueError(
+            "--pp composes with --dp only (GPipe engine); drop "
+            "--fsdp/--tp/--sp/ring/ulysses or use the sharded trainer")
+    if use_pp:
+        dp = args.dp if args.dp > 0 else len(jax.devices()) // args.pp
+        mesh = mesh_lib.make_mesh({"pipeline": args.pp, "data": dp})
+    else:
+        # Context-parallel shard_map specs name the "sequence" axis, so keep
+        # it in the mesh even at size 1 when CP attention is requested.
+        mesh = mesh_lib.make_mesh(cfg.MeshConfig(
+            data=args.dp, fsdp=args.fsdp, tensor=args.tp,
+            sequence=args.sp).to_axis_sizes(
+                keep=("sequence",) if use_cp else ()))
 
     model_cfg = build_config(args)
     seq_len = args.seq_len or min(model_cfg.max_seq_len, 512)
@@ -148,11 +162,7 @@ def main(argv: list[str] | None = None) -> dict:
     # Chunked CE defaults on for the 8B preset, where the [B,S,V] logits
     # tensor (V=128256) is the single largest activation in the step.
     chunked = (args.chunked_ce if args.chunked_ce is not None
-               else args.preset == "8b")
-
-    def loss(params, batch, rng):
-        return llama.loss_fn(model, params, batch, rng,
-                             attention_fn=attention_fn, chunked=chunked)
+               else args.preset == "8b" and not use_pp)
 
     # LM convention: --num-steps is the optimizer-step budget as given (the
     # reference's steps//world rule, tensorflow_mnist.py:146, presumes a fixed
@@ -163,10 +173,27 @@ def main(argv: list[str] | None = None) -> dict:
         optim.make_schedule(args.schedule, conf.lr, num_steps,
                             args.warmup_steps),
         grad_clip=args.grad_clip or None)
-    trainer = sharding.ShardedTrainer(loss, optimizer, mesh)
     init = lambda r: model.init(r, jnp.zeros((1, 8), jnp.int32))["params"]
-    state = trainer.init(init, jax.random.key(conf.seed))
-    step_fn = trainer.make_step(donate=True, microbatches=conf.grad_accum)
+    if use_pp:
+        from k8s_distributed_deeplearning_tpu.parallel import pipeline_lm
+        if chunked:
+            raise ValueError("--chunked-ce is not supported with --pp yet")
+        trainer = pipeline_lm.PipelineTrainer(
+            model, optimizer, mesh,
+            num_microbatches=args.pp_microbatches or args.pp)
+        loss = trainer.loss_fn
+        state = trainer.init(init, jax.random.key(conf.seed))
+        step_fn = trainer.make_step(donate=True)
+        if conf.grad_accum > 1:
+            raise ValueError("--grad-accum with --pp: raise --pp-microbatches "
+                             "instead (the pipeline already microbatches)")
+    else:
+        def loss(params, batch, rng):
+            return llama.loss_fn(model, params, batch, rng,
+                                 attention_fn=attention_fn, chunked=chunked)
+        trainer = sharding.ShardedTrainer(loss, optimizer, mesh)
+        state = trainer.init(init, jax.random.key(conf.seed))
+        step_fn = trainer.make_step(donate=True, microbatches=conf.grad_accum)
 
     tokens = data_lib.load_tokens(args.data_path,
                                   vocab_size=model_cfg.vocab_size,

@@ -91,9 +91,31 @@ def main(argv: list[str] | None = None) -> dict:
                              process_index=topo.process_index,
                              num_processes=topo.num_processes)
 
+    if conf.keep_best and not conf.eval_every:
+        raise ValueError("--keep-best needs --eval-every to produce the "
+                         "metric it ranks checkpoints by")
+
     metrics = MetricsLogger(enabled=distributed.is_primary(), job="mnist")
     ckpt = Checkpointer(conf.checkpoint_dir,
-                        max_to_keep=conf.max_checkpoints_to_keep)
+                        max_to_keep=conf.max_checkpoints_to_keep,
+                        keep_best_metric="accuracy" if conf.keep_best else None,
+                        best_mode="max")
+
+    # Mid-training validation hook (Keras per-epoch eval parity,
+    # tensorflow_mnist_gpu.py:173-182); feeds best-checkpoint retention.
+    eval_fn = None
+    if conf.eval_every:
+        val_x, val_y = data_lib.load_or_synthesize(conf.data_dir, "test",
+                                                   seed=conf.seed)
+        val_step = jax.jit(lambda p, b: mnist.eval_fn(model, p, b))
+        n_val = min(len(val_x), 1000)
+
+        def eval_fn(state):
+            return loop.evaluate(
+                val_step, state.params,
+                iter(ShardedBatcher(val_x[:n_val], val_y[:n_val], 200,
+                                    seed=conf.seed)),
+                num_batches=max(1, n_val // 200))
     metrics.emit("start", world_size=world, num_steps=num_steps, lr=lr,
                  reduction=reduction.value, platform=topo.platform,
                  device_kind=topo.device_kind)
@@ -116,6 +138,7 @@ def main(argv: list[str] | None = None) -> dict:
             global_batch_size=conf.batch_size * world,
             flops_per_example=mnist.flops_per_example(),
             peak_flops=mesh_lib.peak_flops_per_device(conf.dtype),
+            eval_every=conf.eval_every, eval_fn=eval_fn,
         )
 
         result: dict = {"num_steps": num_steps, "world_size": world}

@@ -38,6 +38,11 @@ class TrainConfig:
     checkpoint_dir: str = "./checkpoints"
     checkpoint_every: int = 1000
     max_checkpoints_to_keep: int = 3
+    # Mid-training eval + best-checkpoint retention (Keras variant parity:
+    # per-epoch validation and ModelCheckpoint(save_best_only=True),
+    # tensorflow_mnist_gpu.py:160-163,173-182). eval_every=0 disables.
+    eval_every: int = 0
+    keep_best: bool = False
 
     # Data
     data_dir: str | None = None      # MNIST idx files; None -> synthetic
@@ -146,6 +151,12 @@ def add_train_flags(parser: argparse.ArgumentParser,
                         choices=["float32", "bfloat16"])
     parser.add_argument("--no-eval", dest="eval_final", action="store_false",
                         default=d.eval_final)
+    parser.add_argument("--eval-every", type=int, default=d.eval_every,
+                        help="mid-training eval cadence in steps (0 = off)")
+    parser.add_argument("--keep-best", action="store_true", default=d.keep_best,
+                        help="retain the best checkpoints by eval metric "
+                             "instead of the newest (save_best_only parity); "
+                             "requires --eval-every")
     parser.add_argument("--prefetch", type=int, default=2,
                         help="batches staged ahead by a host thread (0 = off)")
     # Default OFF: the reference parity path (mnist) uses bare Adam

@@ -60,26 +60,52 @@ def _adasum_pair(a: PyTree, b: PyTree) -> PyTree:
 
 
 def adasum_reduce(grads: PyTree, axis_name: str, axis_size: int) -> PyTree:
-    """Adasum-allreduce *grads* across mesh axis ``axis_name``.
+    """Adasum-allreduce *grads* across mesh axis ``axis_name`` — any N.
 
-    Recursive doubling: at round r each rank exchanges its running reduction
-    with the rank differing in bit r (XOR butterfly) and combines with the
-    adaptive pair rule. After log2(N) rounds every rank holds the identical
-    Adasum of all N gradients. ``axis_size`` must be a power of two (the mesh
-    constructor enforces device counts; TPU slices are powers of two).
+    Power-of-two N: recursive doubling — at round r each rank exchanges its
+    running reduction with the rank differing in bit r (XOR butterfly) and
+    combines with the adaptive pair rule; after log2(N) rounds every rank
+    holds the identical Adasum of all N gradients.
+
+    Arbitrary N (parity with Horovod, which accepts any ``-np``,
+    ``tensorflow_mnist.py:133``): let p = 2^floor(log2 N), r = N - p. The r
+    residual ranks (p..N-1) first fold their gradient into ranks 0..r-1 with
+    the pair rule, the p low ranks run the butterfly, and the result is
+    ppermuted back out to the residual ranks. Ranks outside a ppermute's
+    target set receive zeros, and the pair rule's zero-norm guard makes
+    combining-with-zero the identity — so the same SPMD program is correct on
+    every rank with two extra neighbor hops total.
 
     The rounds unroll at trace time (axis_size is static), so XLA sees a fixed
     chain of ppermute+elementwise and can overlap communication with the dot
     products of the next round.
     """
-    if axis_size & (axis_size - 1):
-        raise ValueError(f"adasum requires power-of-two axis size, got {axis_size}")
+    p = 1 << (axis_size.bit_length() - 1)   # largest power of two <= N
+    r = axis_size - p
+    idx = lax.axis_index(axis_name)
+
+    if r:
+        # Fold-in: residual rank p+j sends to rank j; receivers combine,
+        # everyone else combines with zeros (identity by the norm guard).
+        fold = [(p + j, j) for j in range(r)]
+        partner = jax.tree.map(
+            lambda g: lax.ppermute(g, axis_name, fold), grads)
+        grads = _adasum_pair(grads, partner)
+
     dist = 1
-    while dist < axis_size:
-        perm = [(i, i ^ dist) for i in range(axis_size)]
+    while dist < p:
+        perm = [(i, i ^ dist) for i in range(p)]
         partner = jax.tree.map(lambda g: lax.ppermute(g, axis_name, perm), grads)
         grads = _adasum_pair(grads, partner)
         dist *= 2
+
+    if r:
+        # Broadcast back: rank j returns the reduction to residual rank p+j.
+        unfold = [(j, p + j) for j in range(r)]
+        back = jax.tree.map(
+            lambda g: lax.ppermute(g, axis_name, unfold), grads)
+        grads = jax.tree.map(
+            lambda g, b: jnp.where(idx >= p, b, g), grads, back)
     return grads
 
 

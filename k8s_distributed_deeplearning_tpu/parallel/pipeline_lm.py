@@ -1,0 +1,211 @@
+"""Pipeline parallelism for the real transformer — the GPipe × DP trainer.
+
+Bridges the scan-stacked transformer core to the shard_map GPipe schedule in
+:mod:`parallel.pipeline`:
+
+- ``nn.scan`` already stores every Block's weights stacked on a leading
+  "layers" axis (``models/transformer.py``) — exactly the layout
+  ``pipeline_apply`` shards over the "pipeline" mesh axis, so the adapter is
+  a *slicing contract*, not a rewrite: ``block_fn`` applies one unstacked
+  :class:`~models.transformer.Block` to one layer's slice of that stack;
+- embedding, final norm, and LM head run **outside** the shard_map as plain
+  global-array compute (replicated over the pipeline axis, data-sharded over
+  "data" by XLA) — only the layer stack is pipelined. This keeps the
+  schedule's gradient transposition on the already-parity-tested path
+  (``tests/test_pipeline.py``) and the head math identical to ``LMHead``;
+- data parallelism composes by sharding the batch over the "data" mesh axis:
+  global-array semantics derive the gradient all-reduce, no engine changes.
+
+No reference analog (the reference's only strategy is DP — SURVEY.md §2c);
+this closes the "pipeline has never touched a real transformer" gap.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from k8s_distributed_deeplearning_tpu.models import transformer as tfm
+from k8s_distributed_deeplearning_tpu.parallel import pipeline
+from k8s_distributed_deeplearning_tpu.parallel.data_parallel import TrainState
+
+PyTree = Any
+
+
+def block_fn_from_config(cfg: tfm.TransformerConfig) -> Callable:
+    """``block_fn(one_layer_params, x) -> x`` for ``pipeline_apply``: one
+    pre-norm transformer Block applied functionally to a single layer's
+    slice of the scan-stacked weights. ``cfg.remat`` checkpoints each layer
+    (the backward recomputes the block instead of storing activations —
+    per-stage memory then scales with layers/stage, not layers)."""
+    block = tfm.Block(cfg)
+
+    def block_fn(layer_params, x):
+        return block.apply({"params": layer_params}, x)
+
+    return jax.checkpoint(block_fn) if cfg.remat else block_fn
+
+
+def _check_supported(cfg: tfm.TransformerConfig, batch: PyTree | None = None):
+    if not cfg.scan_layers:
+        raise ValueError(
+            "pipeline parallelism consumes the nn.scan-stacked layer layout; "
+            "set scan_layers=True (the default)")
+    if cfg.dropout_rate:
+        raise NotImplementedError(
+            "dropout on the pipeline path is not supported yet (block_fn "
+            "applies layers deterministically — silently skipping dropout "
+            "would diverge from the sharded trainer); set dropout_rate=0")
+    if batch is not None and "segment_ids" in batch:
+        raise NotImplementedError(
+            "packed-sequence (segment_ids) batches are not supported on the "
+            "pipeline path yet — the per-layer block_fn would need the "
+            "segment mask threaded through the schedule")
+
+
+def make_logits_fn(model, mesh: Mesh, *, num_microbatches: int,
+                   axis_name: str = "pipeline",
+                   data_axes: tuple[str, ...] = ("data",)) -> Callable:
+    """``fn(params, tokens) -> [B, S, V] f32 logits`` with the layer stack
+    pipelined over *axis_name*. *params* is the (boxed or unboxed) tree from
+    ``model.init`` — the scan-stacked "blocks" subtree feeds the schedule;
+    embed/norm/head replicate. Numerics match ``model.apply`` (same modules,
+    functionally applied)."""
+    import flax.linen as nn
+
+    cfg = model.cfg
+    _check_supported(cfg)
+    pipe = pipeline.make_pipeline_fn(
+        mesh, block_fn_from_config(cfg),
+        num_microbatches=num_microbatches,
+        axis_name=axis_name, data_axes=data_axes)
+    norm = tfm.make_norm(cfg, None)
+
+    def fn(params, tokens):
+        params = nn.meta.unbox(params)
+        tp = params["transformer"]
+        emb = tp["tok_embed"]["embedding"]
+        x = jnp.take(emb, tokens, axis=0).astype(cfg.dtype)
+        if cfg.position == "learned":
+            pos = tp["pos_embed"]["embedding"]
+            x = x + jnp.take(pos, jnp.arange(tokens.shape[1]), axis=0
+                             ).astype(cfg.dtype)
+        x = pipe(tp["blocks"], x)
+        x = norm.apply({"params": tp["final_norm"]}, x)
+        # One source of truth for the head-weight layout contract.
+        from k8s_distributed_deeplearning_tpu.models.llama import unembedding
+        w, layout = unembedding(cfg, params)
+        if layout == "vd":
+            logits = jnp.einsum("bsd,vd->bsv", x, w.astype(cfg.dtype),
+                                preferred_element_type=jnp.float32)
+        else:
+            # Same contraction LMHead's DenseGeneral performs (bf16 matmul,
+            # f32 upcast after) so PP and non-PP losses agree bit-for-bit
+            # at f32 and to bf16 tolerance otherwise.
+            logits = (x @ w.astype(cfg.dtype)).astype(jnp.float32)
+        return logits.astype(jnp.float32)
+
+    return fn
+
+
+class PipelineTrainer:
+    """GPipe × DP trainer with the ShardedTrainer surface (init / make_step /
+    shard_batch) so the training CLIs can swap engines on a flag.
+
+    Mesh must carry *axis_name* (pipeline stages; must divide
+    ``cfg.n_layers``) and may carry *data_axes* (batch sharding). Other
+    parallel axes (tensor/fsdp/sequence) are out of scope for this engine —
+    compose them via the sharded trainer instead.
+    """
+
+    def __init__(self, model, optimizer: optax.GradientTransformation,
+                 mesh: Mesh, *, num_microbatches: int,
+                 axis_name: str = "pipeline",
+                 data_axes: tuple[str, ...] = ("data",)):
+        cfg = model.cfg
+        _check_supported(cfg)
+        stages = mesh.shape[axis_name]
+        if cfg.n_layers % stages:
+            raise ValueError(
+                f"n_layers={cfg.n_layers} must divide evenly into "
+                f"{stages} pipeline stages")
+        self.model = model
+        self.optimizer = optimizer
+        self.mesh = mesh
+        self.axis_name = axis_name
+        self.data_axes = tuple(a for a in data_axes if a in mesh.axis_names)
+        self.num_microbatches = num_microbatches
+        self._logits_fn = make_logits_fn(
+            model, mesh, num_microbatches=num_microbatches,
+            axis_name=axis_name, data_axes=data_axes)
+
+    # -- placement ---------------------------------------------------------
+    def _spec_for_path(self, path) -> P:
+        keys = [getattr(k, "key", getattr(k, "name", None)) for k in path]
+        if "blocks" in keys:
+            return P(self.axis_name)     # stacked layer axis -> stage shard
+        return P()
+
+    def state_shardings(self, abstract_state: PyTree) -> PyTree:
+        def one(path, leaf):
+            spec = (self._spec_for_path(path)
+                    if getattr(leaf, "ndim", 0) else P())
+            return NamedSharding(self.mesh, spec)
+        return jax.tree_util.tree_map_with_path(one, abstract_state)
+
+    def init(self, init_params_fn: Callable[[jax.Array], PyTree],
+             rng: jax.Array) -> TrainState:
+        """Sharded-at-birth: block weights land on their stage, the rest
+        replicates (same jit-out-shardings pattern as ShardedTrainer)."""
+        import flax.linen as nn
+
+        def make_state(r):
+            params = nn.meta.unbox(init_params_fn(r))
+            return TrainState(params=params,
+                              opt_state=self.optimizer.init(params),
+                              step=jnp.zeros((), jnp.int32))
+
+        abstract = jax.eval_shape(make_state, rng)
+        self._state_sh = self.state_shardings(abstract)
+        return jax.jit(make_state, out_shardings=self._state_sh)(rng)
+
+    # -- loss / step -------------------------------------------------------
+    def loss_fn(self, params, batch, rng=None):
+        """Shifted next-token CE on pipelined logits; same contract as
+        ``llama.loss_fn`` (mask honored; no packed segments on this path)."""
+        _check_supported(self.model.cfg, batch)
+        tokens = batch["tokens"]
+        inputs, targets = tokens[:, :-1], tokens[:, 1:]
+        logits = self._logits_fn(params, inputs)
+        mask = batch.get("mask")
+        mask = (jnp.ones_like(targets, jnp.float32) if mask is None
+                else mask[:, 1:])
+        ce = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
+        loss = (ce * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+        acc = (((logits.argmax(-1) == targets) * mask).sum()
+               / jnp.maximum(mask.sum(), 1.0))
+        return loss, {"accuracy": acc, "perplexity": jnp.exp(loss)}
+
+    def make_step(self, donate: bool = True) -> Callable:
+        opt = self.optimizer
+
+        def step(state: TrainState, batch: PyTree, rng: jax.Array):
+            (loss, aux), grads = jax.value_and_grad(
+                self.loss_fn, has_aux=True)(state.params, batch, rng)
+            updates, opt_state = opt.update(grads, state.opt_state,
+                                            state.params)
+            params = optax.apply_updates(state.params, updates)
+            return (TrainState(params, opt_state, state.step + 1), loss, aux)
+
+        return jax.jit(step, donate_argnums=(0,) if donate else ())
+
+    def shard_batch(self, batch: PyTree) -> PyTree:
+        sh = NamedSharding(self.mesh, P(self.data_axes or None))
+        if jax.process_count() == 1:
+            return jax.device_put(batch, sh)
+        return jax.tree.map(
+            lambda x: jax.make_array_from_process_local_data(sh, x), batch)

@@ -25,24 +25,51 @@ PyTree = Any
 
 
 class Checkpointer:
-    """Thin synchronous wrapper over an Orbax ``CheckpointManager``."""
+    """Thin synchronous wrapper over an Orbax ``CheckpointManager``.
 
-    def __init__(self, directory: str, max_to_keep: int = 3):
+    ``keep_best_metric`` switches retention to best-by-metric — the
+    ``ModelCheckpoint(..., save_best_only=True)`` semantics of the reference's
+    Keras variant (``tensorflow_mnist_gpu.py:160-163``): saves carry an eval
+    metric via ``save(..., metrics={...})``, and ``max_to_keep`` retains the
+    *best* checkpoints by that metric instead of the newest. Metric-less
+    periodic saves are still accepted (and garbage-collected first), so
+    crash-resume and best-model export coexist in one directory.
+    """
+
+    def __init__(self, directory: str, max_to_keep: int = 3,
+                 keep_best_metric: str | None = None,
+                 best_mode: str = "max"):
         self.directory = os.path.abspath(directory)
+        self.keep_best_metric = keep_best_metric
+        best_kw = {}
+        if keep_best_metric is not None:
+            best_kw = dict(
+                best_fn=lambda m: float(m[keep_best_metric]),
+                best_mode=best_mode,
+                keep_checkpoints_without_metrics=False,
+            )
         self._mgr = ocp.CheckpointManager(
             self.directory,
             options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep,
-                                                 create=True),
+                                                 create=True, **best_kw),
             # Explicit handler so a fresh manager can read item_metadata of an
             # existing checkpoint (restore_params) without a prior save.
             item_handlers=ocp.StandardCheckpointHandler(),
         )
 
-    def save(self, step: int, state: PyTree, force: bool = False) -> bool:
+    def save(self, step: int, state: PyTree, force: bool = False,
+             metrics: dict | None = None) -> bool:
         saved = self._mgr.save(step, args=ocp.args.StandardSave(state),
-                               force=force)
+                               force=force, metrics=metrics)
         self._mgr.wait_until_finished()
         return saved
+
+    def best_step(self) -> int | None:
+        """Step of the best checkpoint by the tracked metric (None when not
+        in best-tracking mode or nothing metric-carrying was saved)."""
+        if self.keep_best_metric is None:
+            return None
+        return self._mgr.best_step()
 
     def latest_step(self) -> int | None:
         return self._mgr.latest_step()

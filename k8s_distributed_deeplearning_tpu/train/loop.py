@@ -42,6 +42,8 @@ def fit(
     preemption: PreemptionHandler | None = None,
     preemption_sync_every: int = 10,
     profiler: StepProfiler | None = None,
+    eval_every: int = 0,
+    eval_fn: Callable[[PyTree], dict] | None = None,
 ) -> PyTree:
     """Run synchronous training for ``num_steps``; returns the final state.
 
@@ -62,6 +64,14 @@ def fit(
     all-gather), so all processes branch identically even when only some pods
     were signalled; single-process jobs react on the next step. *profiler*: a
     :class:`~utils.profiling.StepProfiler` tracing a steady-state step window.
+
+    *eval_fn(state) -> {metric: value}* with *eval_every* adds mid-training
+    evaluation (the Keras variant's per-epoch validation,
+    ``tensorflow_mnist_gpu.py:173-182``); results are emitted as "eval"
+    events, and when *checkpointer* tracks a best metric
+    (``keep_best_metric=``) each eval also saves a metric-carrying checkpoint
+    so the best model — not merely the newest — survives ``max_to_keep``
+    (``ModelCheckpoint save_best_only`` parity, ``:160-163``).
     """
     start_step = 0
     if checkpointer is not None:
@@ -118,6 +128,16 @@ def fit(
                 m = mfu(flops_per_example, eps, n_dev, peak_flops)
             metrics.train_step(step + 1, loss_f, dt_ms, eps,
                                eps / n_dev if n_dev else 0.0, mfu=m, **extra)
+
+        if eval_fn is not None and eval_every and (step + 1) % eval_every == 0:
+            ev = {k: float(v) for k, v in eval_fn(state).items()}
+            if metrics:
+                metrics.emit("eval", step=step + 1, **ev)
+            if (checkpointer is not None
+                    and checkpointer.keep_best_metric is not None):
+                checkpointer.save(step + 1, state, metrics=ev)
+                if metrics:
+                    metrics.emit("checkpoint", step=step + 1, best_tracked=True)
 
         if (checkpointer is not None and checkpoint_every
                 and (step + 1) % checkpoint_every == 0):

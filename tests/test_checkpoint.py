@@ -35,6 +35,82 @@ def test_latest_wins_and_max_to_keep(tmp_path):
     ckpt.close()
 
 
+def test_best_checkpoint_survives_max_to_keep(tmp_path):
+    """save_best_only parity (tensorflow_mnist_gpu.py:160-163): with
+    keep_best_metric, max_to_keep retains the BEST checkpoints by metric —
+    the best (step 3 here) must survive even though 3 newer saves follow."""
+    ckpt = Checkpointer(str(tmp_path / "ck"), max_to_keep=2,
+                        keep_best_metric="accuracy", best_mode="max")
+    history = [(1, 0.50), (2, 0.80), (3, 0.95), (4, 0.70), (5, 0.60),
+               (6, 0.65)]
+    for s, acc in history:
+        ckpt.save(s, _state(float(s)), metrics={"accuracy": acc})
+    assert ckpt.best_step() == 3
+    # Retained set = the 2 best by accuracy: steps 3 (.95) and 2 (.80).
+    kept = {int(p.name) for p in (tmp_path / "ck").iterdir()
+            if p.name.isdigit()}
+    assert kept == {2, 3}
+    restored, step = ckpt.restore_latest(_state(0.0))
+    assert step == 3  # newest surviving == best here
+    np.testing.assert_allclose(restored["params"]["w"], np.full((3, 2), 3.0))
+    ckpt.close()
+
+
+def test_best_mode_min_and_metricless_saves(tmp_path):
+    """best_mode='min' (e.g. val loss); metric-less periodic saves coexist
+    but are collected first, never displacing a best checkpoint."""
+    ckpt = Checkpointer(str(tmp_path / "ck"), max_to_keep=2,
+                        keep_best_metric="loss", best_mode="min")
+    ckpt.save(1, _state(1.0), metrics={"loss": 0.9})
+    ckpt.save(2, _state(2.0), metrics={"loss": 0.2})   # best
+    ckpt.save(3, _state(3.0))                          # periodic, no metric
+    ckpt.save(4, _state(4.0), metrics={"loss": 0.5})
+    assert ckpt.best_step() == 2
+    kept = {int(p.name) for p in (tmp_path / "ck").iterdir()
+            if p.name.isdigit()}
+    assert 2 in kept and 4 in kept and 1 not in kept
+    ckpt.close()
+
+
+def test_fit_eval_hook_feeds_best_checkpointing(tmp_path):
+    """loop.fit(eval_every/eval_fn): eval events fire on cadence and the
+    best state (by the eval metric) survives, not the last."""
+    import jax
+    from k8s_distributed_deeplearning_tpu.train import loop
+
+    # A "model" whose eval metric peaks mid-training: accuracy = -(w-3)^2,
+    # w increments by 1 each step from 0 -> best at step 3.
+    def step_fn(state, batch, rng):
+        new_w = state["w"] + 1.0
+        return dict(state, w=new_w, step=state["step"] + 1), jnp.float32(0.0), {}
+
+    def eval_fn(state):
+        w = float(state["w"])
+        return {"accuracy": -(w - 3.0) ** 2}
+
+    state = {"w": jnp.float32(0.0), "step": jnp.int32(0)}
+    ckpt = Checkpointer(str(tmp_path / "ck"), max_to_keep=1,
+                        keep_best_metric="accuracy", best_mode="max")
+    events = []
+
+    class Rec:
+        def emit(self, event, **kw):
+            events.append((event, kw))
+        def train_step(self, *a, **kw):
+            pass
+
+    loop.fit(step_fn, state, iter(lambda: {}, None), 6, jax.random.key(0),
+             metrics=Rec(), checkpointer=ckpt, checkpoint_every=0,
+             log_every=0, eval_every=1, eval_fn=eval_fn)
+    assert ckpt.best_step() == 3
+    restored, step = ckpt.restore_latest(
+        {"w": jnp.float32(0.0), "step": jnp.int32(0)})
+    assert step == 3 and float(restored["w"]) == 3.0
+    evals = [kw for e, kw in events if e == "eval"]
+    assert len(evals) == 6 and evals[2]["accuracy"] == 0.0
+    ckpt.close()
+
+
 def test_elastic_restore_across_topologies(tmp_path):
     """A checkpoint written under one mesh restores into a different one —
     the elastic-resume story (the reference only links to Horovod elastic,

@@ -78,9 +78,69 @@ def test_adasum_matches_numpy_reference(mesh8):
         np.testing.assert_allclose(got[i], expected, rtol=1e-4, atol=1e-5)
 
 
-def test_adasum_rejects_non_power_of_two():
-    with pytest.raises(ValueError):
-        collectives.adasum_reduce({"g": jnp.ones(3)}, "data", 6)
+def _mesh_n(n):
+    from k8s_distributed_deeplearning_tpu.parallel import mesh as mesh_lib
+    return mesh_lib.make_mesh({"data": n}, devices=jax.devices()[:n])
+
+
+def adasum_np_any(vectors):
+    """Reference Adasum for arbitrary N mirroring the Horovod-parity scheme:
+    fold residual ranks into the low ranks, then recursive-halving over the
+    power-of-two prefix."""
+    vs = list(vectors)
+    n = len(vs)
+    p = 1 << (n.bit_length() - 1)
+    for j in range(n - p):
+        vs[j] = adasum_pair_np(vs[j], vs[p + j])
+    return adasum_np(vs[:p])
+
+
+@pytest.mark.parametrize("n", [3, 5, 6, 7])
+def test_adasum_non_power_of_two_matches_reference(n):
+    rng = np.random.default_rng(n)
+    g = rng.normal(size=(n, 16)).astype(np.float32)
+    mesh = _mesh_n(n)
+    out = np.asarray(
+        _shmap(lambda t: collectives.adasum_reduce(t, "data", n),
+               mesh, P("data"), P("data"))(g))
+    expected = adasum_np_any([g[i] for i in range(n)])
+    for i in range(n):  # every rank (incl. residual ranks) holds the result
+        np.testing.assert_allclose(out[i], expected, rtol=1e-4, atol=1e-5)
+
+
+def test_adasum_non_power_of_two_properties():
+    # Identical grads -> identity; orthogonal grads -> sum. Both must hold
+    # through the fold-in/broadcast-back path, on every rank.
+    n = 6
+    mesh = _mesh_n(n)
+    fn = _shmap(lambda t: collectives.adasum_reduce(t, "data", n),
+                mesh, P("data"), P("data"))
+    same = np.tile(np.arange(4, dtype=np.float32), (n, 1))
+    np.testing.assert_allclose(np.asarray(fn(same)), same, rtol=1e-5)
+    ortho = np.eye(n, dtype=np.float32)
+    np.testing.assert_allclose(np.asarray(fn(ortho)),
+                               np.tile(np.ones(n), (n, 1)),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_adasum_training_converges_world_6():
+    """The K8s-parity case VERDICT flagged: a 6-worker job must train, not
+    crash (Horovod accepts any -np, tensorflow_mnist.py:133)."""
+    import optax
+    from k8s_distributed_deeplearning_tpu.parallel import data_parallel as dp
+    from tests.test_data_parallel import _batch, quad_loss
+
+    mesh = _mesh_n(6)
+    params = {"w": jnp.ones((4, 2)), "b": jnp.zeros((2,))}
+    state = dp.init_state(dp.replicate(params, mesh), optax.sgd(0.05), mesh)
+    step = dp.make_train_step(quad_loss, optax.sgd(0.05), mesh,
+                              reduction=dp.Reduction.ADASUM)
+    losses = []
+    for i in range(30):
+        state, loss, _ = step(state, _batch(24, seed=i % 4), jax.random.key(0))
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.5
+    assert np.isfinite(losses).all()
 
 
 def test_adasum_zero_norm_guard(mesh8):

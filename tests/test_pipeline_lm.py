@@ -1,0 +1,152 @@
+"""Pipeline parallelism on the REAL transformer: PP Llama must match the
+non-PP model — logits, loss, and training — on the virtual 8-device mesh."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from k8s_distributed_deeplearning_tpu.models import llama
+from k8s_distributed_deeplearning_tpu.parallel import mesh as mesh_lib
+from k8s_distributed_deeplearning_tpu.parallel import pipeline_lm
+
+
+def _cfg(**kw):
+    base = dict(vocab_size=64, dim=32, n_layers=4, n_heads=4, n_kv_heads=2,
+                mlp_dim=64, max_seq_len=64, dtype=jnp.float32)
+    base.update(kw)
+    return llama.config_tiny(**base)
+
+
+def _batch(b=8, s=17, seed=0, vocab=64):
+    toks = np.random.default_rng(seed).integers(0, vocab, size=(b, s),
+                                                dtype=np.int32)
+    return {"tokens": jnp.asarray(toks)}
+
+
+@pytest.mark.parametrize("spec,micro", [
+    ({"pipeline": 4, "data": 2}, 4),
+    ({"pipeline": 2, "data": 4}, 2),
+])
+def test_pp_logits_match_model_apply(spec, micro):
+    cfg = _cfg()
+    model = llama.LlamaLM(cfg)
+    mesh = mesh_lib.make_mesh(spec)
+    params = model.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))[
+        "params"]
+    tokens = _batch(b=8, s=16)["tokens"]
+
+    fn = pipeline_lm.make_logits_fn(model, mesh, num_microbatches=micro)
+    pp_logits = fn(params, tokens)
+    ref = model.apply({"params": params}, tokens)
+    np.testing.assert_allclose(np.asarray(pp_logits), np.asarray(ref),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_pp_loss_and_grads_match_non_pp():
+    """The VERDICT parity bar: PP Llama tiny loss == non-PP loss, and the
+    gradients agree leaf-for-leaf (stage-sharded blocks included)."""
+    cfg = _cfg()
+    model = llama.LlamaLM(cfg)
+    mesh = mesh_lib.make_mesh({"pipeline": 4, "data": 2})
+    tr = pipeline_lm.PipelineTrainer(model, optax.sgd(0.1), mesh,
+                                     num_microbatches=4)
+    params = jax.tree.map(
+        lambda x: x,  # fresh tree
+        llama.LlamaLM(cfg).init(jax.random.key(0),
+                                jnp.zeros((1, 8), jnp.int32))["params"])
+    import flax.linen as nn
+    plain = nn.meta.unbox(params)
+    batch = _batch()
+
+    loss_pp, aux_pp = tr.loss_fn(plain, batch)
+    g_pp = jax.grad(lambda p: tr.loss_fn(p, batch)[0])(plain)
+    loss_ref, aux_ref = llama.loss_fn(model, plain, batch)
+    g_ref = jax.grad(lambda p: llama.loss_fn(model, p, batch)[0])(plain)
+
+    np.testing.assert_allclose(float(loss_pp), float(loss_ref), rtol=1e-5)
+    np.testing.assert_allclose(float(aux_pp["accuracy"]),
+                               float(aux_ref["accuracy"]), rtol=1e-5)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=5e-4, atol=1e-5),
+        g_pp, g_ref)
+
+
+def test_pp_trainer_trains_and_matches_dp_step():
+    """One PipelineTrainer step == one ShardedTrainer (pure DP) step from the
+    same init, and multi-step training decreases the loss."""
+    from k8s_distributed_deeplearning_tpu.parallel import sharding
+
+    cfg = _cfg()
+    model = llama.LlamaLM(cfg)
+    opt = optax.sgd(0.1)
+    init = lambda r: model.init(r, jnp.zeros((1, 8), jnp.int32))["params"]
+    batch = _batch()
+
+    mesh_pp = mesh_lib.make_mesh({"pipeline": 4, "data": 2})
+    tr_pp = pipeline_lm.PipelineTrainer(model, opt, mesh_pp,
+                                        num_microbatches=4)
+    st_pp = tr_pp.init(init, jax.random.key(1))
+    step_pp = tr_pp.make_step(donate=False)
+    st_pp, loss_pp, _ = step_pp(st_pp, tr_pp.shard_batch(batch), None)
+
+    mesh_dp = mesh_lib.make_mesh({"data": 8})
+    def dp_loss(params, batch, rng):
+        return llama.loss_fn(model, params, batch, rng)
+    tr_dp = sharding.ShardedTrainer(dp_loss, opt, mesh_dp)
+    st_dp = tr_dp.init(init, jax.random.key(1))
+    st_dp, loss_dp, _ = tr_dp.make_step(donate=False)(
+        st_dp, tr_dp.shard_batch(batch), None)
+
+    np.testing.assert_allclose(float(loss_pp), float(loss_dp), rtol=1e-5)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=5e-4, atol=1e-5),
+        st_pp.params, sharding.unbox(st_dp.params))
+
+    losses = [float(loss_pp)]
+    for i in range(4):
+        st_pp, l, _ = step_pp(st_pp, tr_pp.shard_batch(batch),
+                              jax.random.key(i))
+        losses.append(float(l))
+    assert losses[-1] < losses[0]
+
+
+def test_pp_param_placement():
+    """Block weights are stage-sharded over the pipeline axis; everything
+    else replicates."""
+    cfg = _cfg()
+    model = llama.LlamaLM(cfg)
+    mesh = mesh_lib.make_mesh({"pipeline": 4, "data": 2})
+    tr = pipeline_lm.PipelineTrainer(model, optax.adam(1e-3), mesh,
+                                     num_microbatches=4)
+    st = tr.init(lambda r: model.init(r, jnp.zeros((1, 8), jnp.int32))[
+        "params"], jax.random.key(0))
+    blk = st.params["transformer"]["blocks"]["attn"]["q_proj"]["kernel"]
+    assert blk.sharding.spec == jax.sharding.PartitionSpec("pipeline")
+    emb = st.params["transformer"]["tok_embed"]["embedding"]
+    assert emb.sharding.spec in (jax.sharding.PartitionSpec(),
+                                 jax.sharding.PartitionSpec(None))
+    # Optimizer state mirrors the params placement (adam mu for blocks).
+    mu_blk = st.opt_state[0].mu["transformer"]["blocks"]["attn"]["q_proj"][
+        "kernel"]
+    assert mu_blk.sharding.spec == jax.sharding.PartitionSpec("pipeline")
+
+
+def test_pp_rejects_bad_configs():
+    cfg = _cfg(n_layers=3)
+    model = llama.LlamaLM(cfg)
+    mesh = mesh_lib.make_mesh({"pipeline": 4, "data": 2})
+    with pytest.raises(ValueError, match="pipeline stages"):
+        pipeline_lm.PipelineTrainer(model, optax.sgd(0.1), mesh,
+                                    num_microbatches=2)
+    cfg2 = _cfg(scan_layers=False)
+    with pytest.raises(ValueError, match="scan_layers"):
+        pipeline_lm.PipelineTrainer(llama.LlamaLM(cfg2), optax.sgd(0.1),
+                                    mesh, num_microbatches=2)
+    cfg3 = _cfg()
+    tr = pipeline_lm.PipelineTrainer(llama.LlamaLM(cfg3), optax.sgd(0.1),
+                                     mesh, num_microbatches=4)
+    batch = _batch()
+    batch["segment_ids"] = jnp.zeros_like(batch["tokens"])
+    with pytest.raises(NotImplementedError, match="segment_ids"):
+        tr.loss_fn(jax.eval_shape(lambda: None), batch)

@@ -72,6 +72,27 @@ def test_train_llama_end_to_end(tmp_path):
 
 
 @pytest.mark.slow
+def test_train_llama_pipeline_cli(tmp_path):
+    """--pp: GPipe over the real transformer through the full CLI."""
+    import train_llama
+    result = train_llama.main([
+        "--preset", "tiny", "--pp", "2", "--dp", "4",
+        "--num-steps", "10", "--batch-size", "8", "--seq-len", "128",
+        "--log-every", "5", "--checkpoint-dir", str(tmp_path / "ck"),
+        "--checkpoint-every", "1000",
+    ])
+    assert result["num_steps"] == 10
+    assert result["eval_loss"] < 5.0
+
+
+def test_train_llama_pp_flag_conflicts():
+    import train_llama
+    with pytest.raises(ValueError, match="--pp composes with --dp only"):
+        train_llama.main(["--preset", "tiny", "--pp", "2", "--tp", "2",
+                          "--num-steps", "1"])
+
+
+@pytest.mark.slow
 def test_train_llama_resume(tmp_path):
     import train_llama
     base = ["--preset", "tiny", "--num-steps", "10", "--batch-size", "8",
