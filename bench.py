@@ -1,13 +1,22 @@
-"""Benchmark: MNIST ConvNet data-parallel training throughput on TPU.
+"""Benchmark: training throughput on TPU.
 
 Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": "images/sec/chip", "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": "images/sec/chip", "vs_baseline": N,
+   "extra": {llama tokens/sec/chip + MFU, ...}}
 
-The reference publishes no numbers (BASELINE.md) — its deployed config is the
-MNIST ConvNet on CPU-only K8s pods (2 CPU / 4 Gi per worker,
-``tensorflow-mnist.yaml:49-53``). ``vs_baseline`` is therefore measured
-against a CPU run of the same train step on this host (the reference-hardware
-stand-in), per chip.
+Primary metric is the MNIST ConvNet DP step (the reference's deployed
+workload). The reference publishes no numbers (BASELINE.md) — its deployed
+config is the MNIST ConvNet on CPU-only K8s pods (2 CPU / 4 Gi per worker,
+``tensorflow-mnist.yaml:49-53``) — so ``vs_baseline`` is measured against a
+CPU run of the same train step on this host (the reference-hardware
+stand-in), per chip. ``extra`` carries the transformer numbers
+(tokens/sec/chip and measured MFU on a Llama-small config) that fill
+BASELINE.md's scale-out table.
+
+``--suite attention`` runs the flash-vs-XLA sweep (S in {1024, 2048, 4096},
+fwd and fwd+bwd) that backs BENCHMARKS.md and the default attention_impl
+crossover; it is not part of the default driver run (each config pays a
+remote compile).
 """
 from __future__ import annotations
 
@@ -23,9 +32,9 @@ sys.path.insert(0, REPO)
 
 
 def measure(batch_size: int, steps: int, warmup: int, dtype: str,
-            repeats: int = 1) -> list[float]:
-    """Images/sec of the jitted DP train step, *repeats* timing windows over
-    ONE compiled step (setup and compile paid once)."""
+            repeats: int = 1) -> float:
+    """Median images/sec of the jitted MNIST DP train step (one compiled
+    step; setup and compile paid once — timing via _time_training_steps)."""
     import jax
     import jax.numpy as jnp
     import optax
@@ -46,14 +55,21 @@ def measure(batch_size: int, steps: int, warmup: int, dtype: str,
 
     x, y = data_lib.synthetic_mnist(batch_size, seed=0)
     batch = dp.shard_batch({"image": x, "label": y}, mesh)
+    return _time_training_steps(step, state, batch, rng, batch_size,
+                                steps, warmup, repeats)
 
+
+def _time_training_steps(step, state, batch, rng, n_items: int, steps: int,
+                         warmup: int, repeats: int = 3) -> float:
+    """Median items/sec over *repeats* timing windows of a compiled train
+    step. One shared harness so the honest-sync discipline can't drift:
+    warmup first, then each window ends on a VALUE fetch (``float(loss)``) —
+    on relayed/remote backends ``block_until_ready`` can return before
+    execution truly finishes, which would flatter the number."""
     for _ in range(warmup):
         state, loss, _ = step(state, batch, rng)
-    # Fetch the VALUE, not just readiness: on relayed/remote backends
-    # block_until_ready can return before execution really finishes, which
-    # would flatter the number. float() forces the bytes to the host.
     float(loss)
-    out = []
+    runs = []
     for _ in range(repeats):
         t0 = time.perf_counter()
         for _ in range(steps):
@@ -61,8 +77,205 @@ def measure(batch_size: int, steps: int, warmup: int, dtype: str,
         final = float(loss)
         dt = time.perf_counter() - t0
         assert final == final, "NaN loss in benchmark"
-        out.append(batch_size * steps / dt)
+        runs.append(n_items * steps / dt)
+    return sorted(runs)[len(runs) // 2]
+
+
+def measure_llama(steps: int, warmup: int, batch: int = 8,
+                  seq_len: int = 2048, repeats: int = 3) -> dict:
+    """Tokens/sec/chip + measured MFU of the full sharded train step on a
+    Llama-small config (124M params: dim 768, 12 layers, GQA 12/4, SwiGLU
+    2048, vocab 32000 — the train_llama.py "small" preset) in bf16 with the
+    flash-attention kernel. MFU uses llama.flops_per_token (6N + attention)
+    against the device's public bf16 peak."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from k8s_distributed_deeplearning_tpu.models import llama
+    from k8s_distributed_deeplearning_tpu.parallel import mesh as mesh_lib
+    from k8s_distributed_deeplearning_tpu.parallel import sharding
+
+    mesh = mesh_lib.make_mesh({"data": -1})
+    cfg = llama.config_tiny(vocab_size=32000, dim=768, n_layers=12,
+                            n_heads=12, n_kv_heads=4, mlp_dim=2048,
+                            max_seq_len=seq_len, dtype=jnp.bfloat16,
+                            attention_impl="flash")
+    model = llama.LlamaLM(cfg)
+
+    def loss(params, b, rng):
+        return llama.loss_fn(model, params, b, rng)
+
+    tr = sharding.ShardedTrainer(loss, optax.adamw(3e-4), mesh)
+    state = tr.init(
+        lambda r: model.init(r, jnp.zeros((1, 8), jnp.int32))["params"],
+        jax.random.key(0))
+    step = tr.make_step(donate=True)
+    toks = jax.random.randint(jax.random.key(1), (batch, seq_len + 1), 0,
+                              cfg.vocab_size, dtype=jnp.int32)
+    b = tr.shard_batch({"tokens": toks})
+    tps = _time_training_steps(step, state, b, jax.random.key(2),
+                               batch * seq_len, steps, warmup, repeats)
+    n_chips = jax.device_count()
+    peak = mesh_lib.peak_flops_per_device("bfloat16")
+    mfu = tps / n_chips * llama.flops_per_token(cfg) / peak
+    return {
+        "llama_small_tokens_per_sec_per_chip": round(tps / n_chips, 1),
+        "llama_small_mfu": round(mfu, 4),
+        "llama_small_config": {"params_m": 124, "seq_len": seq_len,
+                               "batch": batch, "dtype": "bfloat16",
+                               "attention": "flash"},
+    }
+
+
+def measure_zoo(steps: int = 15, warmup: int = 3) -> dict:
+    """Single-chip step throughput + MFU for the BASELINE.md scale-out
+    models: BERT-base MLM (110M, the large-gradient-allreduce config),
+    ViT-L/16 (307M), ResNet-50 (25.6M). Full train steps (fwd+bwd+adamw /
+    adam), bf16 compute, real sharded-trainer machinery."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from k8s_distributed_deeplearning_tpu.models import (bert, llama, resnet,
+                                                         vit)
+    from k8s_distributed_deeplearning_tpu.parallel import mesh as mesh_lib
+    from k8s_distributed_deeplearning_tpu.parallel import sharding
+
+    mesh = mesh_lib.make_mesh({"data": -1})
+    n_chips = jax.device_count()
+    peak = mesh_lib.peak_flops_per_device("bfloat16")
+    out: dict = {}
+
+    def time_steps(step, state, batch, rng, n_items):
+        return _time_training_steps(step, state, batch, rng, n_items,
+                                    steps, warmup)
+
+    # --- BERT-base MLM, S=512 ------------------------------------------
+    # remat: without it the 12 layers' [B,H,S,S] f32 score matrices + the
+    # [B,S,30522] MLM logits exceed one v5e's 16G HBM at B=16.
+    cfg = bert.config_bert_base(dtype=jnp.bfloat16, remat=True)
+    model = bert.BertMLM(cfg)
+    B, S = 16, 512
+    tr = sharding.ShardedTrainer(
+        lambda p, b, r: bert.loss_fn(model, p, b, r), optax.adamw(1e-4), mesh)
+    state = tr.init(lambda r: model.init(
+        r, jnp.zeros((1, 8), jnp.int32))["params"], jax.random.key(0))
+    toks = jax.random.randint(jax.random.key(1), (B, S), 0, cfg.vocab_size,
+                              dtype=jnp.int32)
+    inputs, targets, weights = bert.mask_tokens(
+        toks, jax.random.key(2), vocab_size=cfg.vocab_size, mask_id=103)
+    batch = tr.shard_batch({"inputs": inputs, "targets": targets,
+                            "weights": weights})
+    tps = time_steps(tr.make_step(donate=True), state, batch,
+                     jax.random.key(3), B * S)
+    mfu = tps / n_chips * llama.flops_per_token(cfg) / peak
+    out["bert_base_tokens_per_sec_per_chip"] = round(tps / n_chips, 1)
+    out["bert_base_mfu"] = round(mfu, 4)
+
+    # --- ViT-L/16, 224x224 ---------------------------------------------
+    cfg = vit.config_vit_l16(dtype=jnp.bfloat16, remat=True)
+    model = vit.ViT(cfg)
+    B = 32
+    tr = sharding.ShardedTrainer(
+        lambda p, b, r: vit.loss_fn(model, p, b, r), optax.adamw(1e-4), mesh)
+    state = tr.init(lambda r: model.init(
+        r, jnp.zeros((1, 224, 224, 3)))["params"], jax.random.key(0))
+    batch = tr.shard_batch({
+        "image": jax.random.normal(jax.random.key(1), (B, 224, 224, 3),
+                                   jnp.float32),
+        "label": jax.random.randint(jax.random.key(2), (B,), 0, 1000)})
+    ips = time_steps(tr.make_step(donate=True), state, batch,
+                     jax.random.key(3), B)
+    # ViT FLOPs/image ~ transformer flops over 197 tokens.
+    mfu = ips / n_chips * llama.flops_per_token(cfg) * 197 / peak
+    out["vit_l16_images_per_sec_per_chip"] = round(ips / n_chips, 1)
+    out["vit_l16_mfu"] = round(mfu, 4)
+
+    # --- ResNet-50, 224x224 --------------------------------------------
+    sys.path.insert(0, os.path.join(REPO, "examples"))
+    import train_zoo
+    model = resnet.resnet50(dtype=jnp.bfloat16)
+    B = 64
+    opt = optax.adam(1e-3)
+    variables = model.init(jax.random.key(0),
+                           jnp.zeros((1, 224, 224, 3)), train=False)
+    state = train_zoo.ResNetState(variables["params"],
+                                  variables["batch_stats"],
+                                  opt.init(variables["params"]),
+                                  jnp.zeros((), jnp.int32))
+    from k8s_distributed_deeplearning_tpu.parallel import data_parallel as dp
+    state = jax.device_put(state, jax.sharding.NamedSharding(
+        mesh, jax.sharding.PartitionSpec()))
+    step = train_zoo.make_resnet_step(model, opt, mesh)
+    batch = dp.shard_batch({
+        "image": jax.random.normal(jax.random.key(1), (B, 224, 224, 3),
+                                   jnp.float32),
+        "label": jax.random.randint(jax.random.key(2), (B,), 0, 1000)}, mesh)
+    ips = time_steps(step, state, batch, jax.random.key(3), B)
+    mfu = ips / n_chips * resnet.flops_per_example() / peak
+    out["resnet50_images_per_sec_per_chip"] = round(ips / n_chips, 1)
+    out["resnet50_mfu"] = round(mfu, 4)
     return out
+
+
+def measure_attention(seq_lens=(1024, 2048, 4096), steps: int = 20,
+                      warmup: int = 3) -> dict:
+    """Flash (Pallas) vs XLA attention, fwd and fwd+bwd, causal, bf16,
+    [B,S,H,D] with B*S held at 8192 tokens, H=8, D=128. Returns ms per call
+    and the per-S winner — the data behind ops.attention.default_impl."""
+    import jax
+    import jax.numpy as jnp
+
+    from k8s_distributed_deeplearning_tpu.ops.attention import (
+        multi_head_attention)
+
+    results: dict = {}
+    for S in seq_lens:
+        B = max(1, 8192 // S)
+        H, D = 8, 128
+        ks = jax.random.split(jax.random.key(0), 3)
+        q, k, v = (jax.random.normal(kk, (B, S, H, D), jnp.bfloat16)
+                   for kk in ks)
+        row: dict = {}
+        for impl in ("xla", "flash"):
+            fwd = jax.jit(lambda q, k, v, _i=impl: multi_head_attention(
+                q, k, v, causal=True, impl=_i).astype(jnp.float32).sum())
+
+            def loss(q, k, v, _i=impl):
+                return multi_head_attention(
+                    q, k, v, causal=True, impl=_i).astype(jnp.float32).sum()
+
+            grad = jax.jit(lambda q, k, v, _l=loss: sum(
+                g.astype(jnp.float32).sum()
+                for g in jax.grad(_l, argnums=(0, 1, 2))(q, k, v)))
+
+            for name, fn in (("fwd", fwd), ("fwd_bwd", grad)):
+                for _ in range(warmup):
+                    out = fn(q, k, v)
+                float(out)
+                t0 = time.perf_counter()
+                for _ in range(steps):
+                    out = fn(q, k, v)
+                val = float(out)
+                dt = (time.perf_counter() - t0) / steps
+                assert val == val, f"NaN in attention bench {impl} {name}"
+                row[f"{impl}_{name}_ms"] = round(dt * 1e3, 3)
+        row["winner_fwd"] = ("flash" if row["flash_fwd_ms"]
+                             <= row["xla_fwd_ms"] else "xla")
+        row["winner_fwd_bwd"] = ("flash" if row["flash_fwd_bwd_ms"]
+                                 <= row["xla_fwd_bwd_ms"] else "xla")
+        results[f"S{S}"] = row
+    # Regression guard backing the impl="auto" rule: flash must not lose to
+    # XLA at long sequence lengths on TPU hardware.
+    top = results[f"S{max(seq_lens)}"]
+    results["regression_flash_wins_long_s"] = (
+        top["winner_fwd"] == "flash" and top["winner_fwd_bwd"] == "flash")
+    if not results["regression_flash_wins_long_s"]:
+        print(json.dumps({"warning": "flash attention lost to XLA at "
+                          f"S={max(seq_lens)} — impl='auto' rule is stale",
+                          **top}), file=sys.stderr)
+    return results
 
 
 def main() -> None:
@@ -72,6 +285,9 @@ def main() -> None:
     # Default sized for MXU saturation on one v5e chip (measured sweep:
     # 2048 -> ~300k img/s/chip, 16384 -> ~560k, flat beyond).
     ap.add_argument("--batch-size", type=int, default=16384)
+    ap.add_argument("--suite",
+                    choices=["all", "mnist", "llama", "attention", "zoo"],
+                    default="all")
     ap.add_argument("--cpu-baseline", action="store_true",
                     help="internal: measure the CPU reference stand-in")
     args = ap.parse_args()
@@ -87,23 +303,56 @@ def main() -> None:
         jax.config.update("jax_platforms", "cpu")
         jax.config.update("jax_platform_name", "cpu")
         assert jax.devices()[0].platform == "cpu", jax.devices()
-        ips = measure(batch_size=100, steps=10, warmup=2, dtype="float32")[0]
+        ips = measure(batch_size=100, steps=10, warmup=2, dtype="float32")
         print(json.dumps({"cpu_images_per_sec": ips}))
         return
 
     import jax
     n_chips = jax.device_count()
+
+    if args.suite == "attention":
+        print(json.dumps({"metric": "attention_flash_vs_xla",
+                          "unit": "ms/call",
+                          "value": None, "vs_baseline": None,
+                          "extra": measure_attention(steps=args.steps)}))
+        return
+    if args.suite == "llama":
+        extra = measure_llama(args.steps, args.warmup)
+        print(json.dumps({
+            "metric": "llama_small_tokens_per_sec_per_chip",
+            "value": extra["llama_small_tokens_per_sec_per_chip"],
+            "unit": "tokens/sec/chip",
+            "vs_baseline": None,
+            "extra": extra}))
+        return
+    if args.suite == "zoo":
+        extra = measure_zoo(steps=max(5, args.steps // 2))
+        print(json.dumps({
+            "metric": "zoo_single_chip",
+            "value": extra["bert_base_tokens_per_sec_per_chip"],
+            "unit": "tokens/sec/chip (bert-base)",
+            "vs_baseline": None,
+            "extra": extra}))
+        return
+
     # Median of 3 timing windows over one compiled step: remote-tunnel
     # dispatch latency varies window to window, compile is paid once.
-    runs = sorted(measure(args.batch_size, args.steps, args.warmup,
-                          dtype="bfloat16", repeats=3))
-    per_chip = runs[1] / n_chips
+    per_chip = measure(args.batch_size, args.steps, args.warmup,
+                       dtype="bfloat16", repeats=3) / n_chips
+
+    extra: dict = {}
+    if args.suite == "all":
+        try:
+            extra = measure_llama(max(10, args.steps // 3), 3)
+        except Exception as e:  # never lose the primary metric to a crash
+            extra = {"llama_bench_error": repr(e)}
 
     baseline = None
     try:
         env = dict(os.environ, JAX_PLATFORM_NAME="cpu")
         out = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), "--cpu-baseline"],
+            [sys.executable, os.path.abspath(__file__),
+             "--cpu-baseline", "--suite", "mnist"],
             capture_output=True, text=True, timeout=600, env=env, cwd=REPO)
         for line in out.stdout.strip().splitlines():
             try:
@@ -120,6 +369,7 @@ def main() -> None:
         "value": round(per_chip, 1),
         "unit": "images/sec/chip",
         "vs_baseline": round(per_chip / baseline, 2) if baseline else None,
+        **({"extra": extra} if extra else {}),
     }))
 
 

@@ -73,8 +73,8 @@ def build_config(args) -> "llama.TransformerConfig":
     overrides["dtype"] = (jnp.bfloat16 if args.dtype == "bfloat16"
                           else jnp.float32)
     overrides["remat"] = args.remat or overrides.get("remat", False)
-    if args.attention == "flash":
-        overrides["attention_impl"] = "flash"
+    if args.attention in ("flash", "xla"):
+        overrides["attention_impl"] = args.attention
     return base(**overrides)
 
 
@@ -94,8 +94,11 @@ def main(argv: list[str] | None = None) -> dict:
                         "scan-stacked layers; composes with --dp only)")
     parser.add_argument("--pp-microbatches", type=int, default=None,
                         help="pipeline microbatches (default: --pp)")
-    parser.add_argument("--attention", choices=["xla", "flash", "ring", "ulysses"],
-                        default="xla")
+    parser.add_argument("--attention",
+                        choices=["auto", "xla", "flash", "ring", "ulysses"],
+                        default="auto",
+                        help="auto = measured crossover: Pallas flash on TPU "
+                        "at S>=1024, XLA otherwise (BENCHMARKS.md)")
     parser.add_argument("--remat", action="store_true",
                         help="checkpoint each block (long-context memory lever)")
     parser.add_argument("--data-path", type=str, default=None,
@@ -162,7 +165,7 @@ def main(argv: list[str] | None = None) -> dict:
     # Chunked CE defaults on for the 8B preset, where the [B,S,V] logits
     # tensor (V=128256) is the single largest activation in the step.
     chunked = (args.chunked_ce if args.chunked_ce is not None
-               else args.preset == "8b" and not use_pp)
+               else args.preset == "8b")
 
     # LM convention: --num-steps is the optimizer-step budget as given (the
     # reference's steps//world rule, tensorflow_mnist.py:146, presumes a fixed
@@ -176,11 +179,10 @@ def main(argv: list[str] | None = None) -> dict:
     init = lambda r: model.init(r, jnp.zeros((1, 8), jnp.int32))["params"]
     if use_pp:
         from k8s_distributed_deeplearning_tpu.parallel import pipeline_lm
-        if chunked:
-            raise ValueError("--chunked-ce is not supported with --pp yet")
         trainer = pipeline_lm.PipelineTrainer(
             model, optimizer, mesh,
-            num_microbatches=args.pp_microbatches or args.pp)
+            num_microbatches=args.pp_microbatches or args.pp,
+            chunked_ce=chunked)
         loss = trainer.loss_fn
         state = trainer.init(init, jax.random.key(conf.seed))
         step_fn = trainer.make_step(donate=True)

@@ -1,13 +1,19 @@
-"""CLI: render (and optionally apply) TPUJob manifests.
+"""CLI: render, validate, locally execute, or apply TPUJob manifests.
 
 Usage:
   python -m k8s_distributed_deeplearning_tpu.launch render --workers 4 \
       --name tpu-mnist --script examples/train_mnist.py -- --num-steps 20000
   python -m k8s_distributed_deeplearning_tpu.launch render ... --apply
+  python -m k8s_distributed_deeplearning_tpu.launch validate --workers 4
+  python -m k8s_distributed_deeplearning_tpu.launch run-local --workers 2 \
+      -- --num-steps 40 --no-eval
 
-The ``--apply`` path shells to kubectl like ``deploy_stack.sh:46`` does, but
-waits for the namespace first (fixing the reference's CRD-not-ready race,
-``deploy_stack.sh:38,46``; here there is no CRD at all — core Job objects).
+``validate`` runs the offline structural checks and, when kubectl can reach
+a cluster, a server-side dry-run. ``run-local`` executes the rendered pod
+template as local processes (the mpirun-local-mode analog; see
+``launch/local_executor.py``). The ``--apply`` path shells to kubectl like
+``deploy_stack.sh:46`` does, but validates first (fixing the reference's
+apply-and-hope flow; here there is no CRD at all — core Job objects).
 """
 from __future__ import annotations
 
@@ -16,7 +22,7 @@ import subprocess
 import sys
 
 from k8s_distributed_deeplearning_tpu.config import JobConfig
-from k8s_distributed_deeplearning_tpu.launch import render
+from k8s_distributed_deeplearning_tpu.launch import render, validate
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -28,19 +34,25 @@ def main(argv: list[str] | None = None) -> int:
 
     ap = argparse.ArgumentParser(prog="launch")
     sub = ap.add_subparsers(dest="cmd", required=True)
-    r = sub.add_parser("render", help="render TPUJob manifests to stdout")
     d = JobConfig()
-    r.add_argument("--name", default=d.name)
-    r.add_argument("--namespace", default=d.namespace)
-    r.add_argument("--workers", type=int, default=d.num_workers)
-    r.add_argument("--image", default=d.image)
-    r.add_argument("--script", default=d.script)
-    r.add_argument("--tpu-topology", default=d.tpu_topology)
-    r.add_argument("--tpu-accelerator", default=d.tpu_accelerator)
-    r.add_argument("--cpu", default=d.cpu)
-    r.add_argument("--memory", default=d.memory)
-    r.add_argument("--apply", action="store_true",
-                   help="pipe the manifests into kubectl apply -f -")
+    parsers = {}
+    for name, help_ in (("render", "render TPUJob manifests to stdout"),
+                        ("validate", "validate rendered manifests"),
+                        ("run-local", "execute the rendered job locally")):
+        p = parsers[name] = sub.add_parser(name, help=help_)
+        p.add_argument("--name", default=d.name)
+        p.add_argument("--namespace", default=d.namespace)
+        p.add_argument("--workers", type=int, default=d.num_workers)
+        p.add_argument("--image", default=d.image)
+        p.add_argument("--script", default=d.script)
+        p.add_argument("--tpu-topology", default=d.tpu_topology)
+        p.add_argument("--tpu-accelerator", default=d.tpu_accelerator)
+        p.add_argument("--cpu", default=d.cpu)
+        p.add_argument("--memory", default=d.memory)
+    parsers["render"].add_argument(
+        "--apply", action="store_true",
+        help="pipe the manifests into kubectl apply -f -")
+    parsers["run-local"].add_argument("--timeout", type=int, default=600)
     args = ap.parse_args(argv)
 
     cfg = JobConfig(name=args.name, namespace=args.namespace,
@@ -51,9 +63,38 @@ def main(argv: list[str] | None = None) -> int:
                     cpu=args.cpu, memory=args.memory)
     docs = render.render_all(cfg)
     text = render.to_yaml(docs)
+
+    if args.cmd == "validate":
+        errors = validate.validate(docs)
+        for e in errors:
+            print(f"ERROR: {e}", file=sys.stderr)
+        if not errors:
+            print(f"offline validation: OK ({len(docs)} objects)")
+            if validate.kubectl_available():
+                ok, out = validate.kubectl_validate(text)
+                print(f"kubectl server dry-run: {'OK' if ok else 'FAILED'}")
+                if not ok:
+                    print(out, file=sys.stderr)
+                    return 1
+        return 1 if errors else 0
+
+    if args.cmd == "run-local":
+        from k8s_distributed_deeplearning_tpu.launch import local_executor
+        results = local_executor.run_local(cfg, timeout=args.timeout)
+        for r in results:
+            sys.stdout.write(r.stdout)
+            if r.returncode != 0:
+                sys.stderr.write(r.stderr[-4000:])
+                print(f"worker {r.index} exited {r.returncode}",
+                      file=sys.stderr)
+        # max() would mask signal deaths (negative returncodes) behind a
+        # clean worker's 0 — any non-zero worker fails the gang.
+        return 0 if all(r.returncode == 0 for r in results) else 1
+
     if not args.apply:
         print(text)
         return 0
+    validate.validate_or_raise(docs)
     proc = subprocess.run(["kubectl", "apply", "-f", "-"], input=text,
                           text=True)
     return proc.returncode

@@ -1,0 +1,172 @@
+"""Manifest validation — execution-grade checks without a cluster.
+
+The reference's only verification of its deploy layer was deploying it
+(``deploy_stack.sh:3,31`` — ``set -e`` + ``helm --wait``; SURVEY.md §4).
+This module gives the rendered TPUJob manifests three tiers of checking:
+
+1. :func:`validate` — offline structural validation (no cluster, runs in
+   CI): K8s object shape, RFC-1123 names, resource-quantity syntax, env
+   fieldRef correctness, and — most importantly — the cross-object
+   *contract*: the coordinator address must point at completion index 0
+   through the headless Service, TPUJOB_NUM_PROCESSES must equal the Job's
+   completions, the Service selector must match the Job's pods.
+2. ``kubectl --dry-run`` (:func:`kubectl_validate`) — server-side schema
+   validation when a cluster (or kind) is reachable; skipped otherwise.
+3. :mod:`launch.local_executor` — actually *runs* the manifest's pod
+   template locally, the strongest no-cluster check.
+"""
+from __future__ import annotations
+
+import re
+import shutil
+import subprocess
+
+_RFC1123 = re.compile(r"^[a-z0-9]([a-z0-9-]{0,251}[a-z0-9])?$")
+# K8s resource.Quantity (the practical subset: plain/decimal-SI/binary-SI).
+_QUANTITY = re.compile(r"^[0-9]+(\.[0-9]+)?(m|k|M|G|T|P|Ki|Mi|Gi|Ti|Pi)?$")
+_ENV_NAME = re.compile(r"^[A-Za-z_][A-Za-z0-9_]*$")
+_ALLOWED_FIELDREFS = {
+    "metadata.name", "metadata.namespace", "metadata.uid", "spec.nodeName",
+    "status.podIP", "status.hostIP",
+    "metadata.annotations['batch.kubernetes.io/job-completion-index']",
+}
+
+
+def _err(errors: list[str], where: str, msg: str) -> None:
+    errors.append(f"{where}: {msg}")
+
+
+def _check_name(errors, where, name) -> None:
+    if not isinstance(name, str) or not _RFC1123.match(name or ""):
+        _err(errors, where, f"invalid RFC-1123 name {name!r}")
+
+
+def _check_container(errors, where: str, c: dict) -> None:
+    if not c.get("image"):
+        _err(errors, where, "container has no image")
+    if not c.get("command") and not c.get("args"):
+        _err(errors, where, "container has neither command nor args")
+    seen = set()
+    for e in c.get("env", []):
+        n = e.get("name", "") or ""
+        if not _ENV_NAME.match(n):
+            _err(errors, where, f"invalid env var name {n!r}")
+        if n in seen:
+            _err(errors, where, f"duplicate env var {n!r}")
+        seen.add(n)
+        if ("value" in e) == ("valueFrom" in e):
+            _err(errors, where,
+                 f"env {n!r} needs exactly one of value/valueFrom")
+        ref = (e.get("valueFrom") or {}).get("fieldRef", {}).get("fieldPath")
+        if "valueFrom" in e and ref not in _ALLOWED_FIELDREFS:
+            _err(errors, where, f"env {n!r} references unknown fieldPath "
+                 f"{ref!r}")
+    for kind in ("requests", "limits"):
+        for res, qty in (c.get("resources", {}).get(kind) or {}).items():
+            if not _QUANTITY.match(str(qty)):
+                _err(errors, where,
+                     f"{kind}.{res} quantity {qty!r} is not a valid "
+                     "Kubernetes resource quantity")
+
+
+def validate(docs: list[dict]) -> list[str]:
+    """Validate rendered manifests; returns a list of errors (empty = OK)."""
+    errors: list[str] = []
+    by_kind: dict[str, list[dict]] = {}
+    for i, d in enumerate(docs):
+        where = f"doc[{i}]"
+        if not isinstance(d, dict) or not d.get("kind"):
+            _err(errors, where, "not a Kubernetes object (no kind)")
+            continue
+        by_kind.setdefault(d["kind"], []).append(d)
+        if not d.get("apiVersion"):
+            _err(errors, where, "missing apiVersion")
+        _check_name(errors, f"{where}({d['kind']})",
+                    d.get("metadata", {}).get("name"))
+
+    namespaces = {d["metadata"]["name"] for d in by_kind.get("Namespace", [])}
+    for d in by_kind.get("Service", []) + by_kind.get("Job", []):
+        ns = d["metadata"].get("namespace")
+        if namespaces and ns not in namespaces:
+            _err(errors, d["kind"], f"namespace {ns!r} is not rendered "
+                 f"alongside (have {sorted(namespaces)})")
+
+    for job in by_kind.get("Job", []):
+        where = f"Job/{job['metadata'].get('name')}"
+        spec = job.get("spec", {})
+        comp, par = spec.get("completions"), spec.get("parallelism")
+        if spec.get("completionMode") != "Indexed":
+            _err(errors, where, "completionMode must be Indexed (gang rank "
+                 "identity comes from the completion index)")
+        if not (isinstance(comp, int) and comp >= 1 and comp == par):
+            _err(errors, where, f"completions ({comp}) must equal "
+                 f"parallelism ({par}) >= 1 for gang semantics")
+        tmpl = spec.get("template", {}).get("spec", {})
+        if tmpl.get("restartPolicy") not in ("Never", "OnFailure"):
+            _err(errors, where, "Job pods need restartPolicy Never/OnFailure")
+        containers = tmpl.get("containers") or []
+        if not containers:
+            _err(errors, where, "no containers in pod template")
+        for c in containers:
+            _check_container(errors, where, c)
+
+        # The distributed-bootstrap contract (what a typo here costs: every
+        # pod hangs in jax.distributed.initialize at startup).
+        env = {e["name"]: e for e in containers[0].get("env", [])
+               } if containers else {}
+        name, ns = job["metadata"].get("name"), job["metadata"].get("namespace")
+        coord = env.get("TPUJOB_COORDINATOR_ADDRESS", {}).get("value", "")
+        host, _, port = coord.partition(":")
+        subdomain = tmpl.get("subdomain")
+        expect_host = f"{name}-0.{subdomain}.{ns}"
+        if host != expect_host:
+            _err(errors, where, f"coordinator host {host!r} != "
+                 f"<job>-0.<subdomain>.<ns> ({expect_host!r})")
+        if env.get("TPUJOB_NUM_PROCESSES", {}).get("value") != str(comp):
+            _err(errors, where, "TPUJOB_NUM_PROCESSES != completions")
+        pid_ref = (env.get("TPUJOB_PROCESS_ID", {}).get("valueFrom", {})
+                   .get("fieldRef", {}).get("fieldPath", ""))
+        if "job-completion-index" not in pid_ref:
+            _err(errors, where, "TPUJOB_PROCESS_ID must come from the "
+                 "job-completion-index annotation")
+        for svc in by_kind.get("Service", []):
+            if svc["metadata"].get("name") == subdomain:
+                if svc["spec"].get("clusterIP") != "None":
+                    _err(errors, where, "coordinator Service must be "
+                         "headless (clusterIP: None) for per-pod DNS")
+                ports = [p.get("port") for p in svc["spec"].get("ports", [])]
+                if not port.isdigit():
+                    _err(errors, where, f"coordinator port {port!r} is not "
+                         "numeric")
+                elif int(port) not in ports:
+                    _err(errors, where, f"coordinator port {port} not "
+                         f"exposed by Service ({ports})")
+                break
+        else:
+            _err(errors, where, f"no headless Service named {subdomain!r} "
+                 "rendered — pod DNS names will not resolve")
+    return errors
+
+
+def validate_or_raise(docs: list[dict]) -> None:
+    errors = validate(docs)
+    if errors:
+        raise ValueError("manifest validation failed:\n  "
+                         + "\n  ".join(errors))
+
+
+def kubectl_available() -> bool:
+    return shutil.which("kubectl") is not None
+
+
+def kubectl_validate(yaml_text: str, server: bool = True,
+                     timeout: int = 60) -> tuple[bool, str]:
+    """``kubectl apply --dry-run`` the manifests (server-side when a cluster
+    answers). Returns (ok, output); raises RuntimeError without kubectl."""
+    if not kubectl_available():
+        raise RuntimeError("kubectl not on PATH")
+    mode = "server" if server else "client"
+    proc = subprocess.run(
+        ["kubectl", "apply", f"--dry-run={mode}", "-f", "-"],
+        input=yaml_text, text=True, capture_output=True, timeout=timeout)
+    return proc.returncode == 0, proc.stdout + proc.stderr

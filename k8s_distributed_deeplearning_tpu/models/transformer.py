@@ -55,7 +55,9 @@ class TransformerConfig:
     rope_theta: float = 500000.0        # Llama-3 default
     tie_embeddings: bool = False
     dtype: Dtype = jnp.bfloat16         # compute dtype; params stay f32
-    attention_impl: str = "xla"         # "xla" | "flash" (pallas)
+    attention_impl: str = "auto"        # "auto" | "xla" | "flash" (pallas);
+                                        # auto = measured per-platform/seq-len
+                                        # rule (ops.attention.default_impl)
     remat: bool = False                 # checkpoint each block
     scan_layers: bool = True            # stack layers via nn.scan
     dropout_rate: float = 0.0

@@ -23,6 +23,24 @@ import jax
 import jax.numpy as jnp
 
 
+def default_impl(seq_len: int, platform: str | None = None) -> str:
+    """Data-driven attention-impl selection (the ``impl="auto"`` rule).
+
+    Measured on TPU v5e (BENCHMARKS.md, bench.py --suite attention): the
+    Pallas flash kernel beats XLA einsum attention at every tested length —
+    S=1024 (1.3x fwd / 1.9x fwd+bwd), S=2048 (1.4x / 2.1x), S=4096
+    (2.1x / 2.2x) — so TPU picks flash whenever the sequence is long enough
+    to tile well (>= 1024, 128-aligned). Off-TPU (CPU CI) flash runs in the
+    Pallas interpreter, which is orders of magnitude slower than XLA: always
+    pick xla there.
+    """
+    if platform is None:
+        platform = jax.devices()[0].platform
+    if platform in ("tpu", "axon") and seq_len >= 1024 and seq_len % 128 == 0:
+        return "flash"
+    return "xla"
+
+
 def _repeat_kv(k: jax.Array, num_q_heads: int) -> jax.Array:
     """Expand KV heads to match Q heads for grouped-query attention."""
     num_kv = k.shape[2]
@@ -90,7 +108,10 @@ def multi_head_attention(
     ``segment_ids`` is the packed-sequence mask (attend within equal ids);
     the flash path consumes it natively, the XLA path expands it to a
     boolean mask. General ``mask`` arrays force the XLA path.
+    ``impl="auto"`` resolves per the measured crossover (:func:`default_impl`).
     """
+    if impl == "auto":
+        impl = default_impl(q.shape[1])
     if impl == "flash" and mask is None:
         from k8s_distributed_deeplearning_tpu.ops import pallas_flash
         return pallas_flash.flash_attention(

@@ -124,12 +124,14 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     """
     n = lax.axis_size(axis_name)
     hq, hkv = q.shape[2], k.shape[2]
-    if hkv != hq:  # GQA: expand before the head split so H/N stays integral
-        q_rep = 1
-        k = _repeat_kv(k, hq // hkv)
-        v = _repeat_kv(v, hq // hkv)
     if hq % n:
         raise ValueError(f"ulysses needs heads {hq} divisible by axis size {n}")
+    if hkv != hq and hkv % n:
+        # KV heads don't split across the axis: expand before the all-to-all
+        # (pays the expansion bandwidth in the redistribute — unavoidable).
+        k = _repeat_kv(k, hq // hkv)
+        v = _repeat_kv(v, hq // hkv)
+        hkv = hq
 
     def seq_to_heads(x):  # [B, S/N, H, D] -> [B, S, H/N, D]
         return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
@@ -140,6 +142,13 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                               tiled=True)
 
     qg, kg, vg = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    if hkv != hq:
+        # GQA: redistribute the small KV tensors, expand locally AFTER the
+        # all-to-all — hq/hkv x less interconnect traffic. The local repeat
+        # matches the q-head grouping because all_to_all splits consecutive
+        # head blocks and _repeat_kv repeats each kv head consecutively.
+        kg = _repeat_kv(kg, hq // hkv)
+        vg = _repeat_kv(vg, hq // hkv)
     if inner is None:
         from k8s_distributed_deeplearning_tpu.ops.attention import (
             dot_product_attention)

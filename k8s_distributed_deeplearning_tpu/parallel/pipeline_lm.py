@@ -67,14 +67,13 @@ def _check_supported(cfg: tfm.TransformerConfig, batch: PyTree | None = None):
             "segment mask threaded through the schedule")
 
 
-def make_logits_fn(model, mesh: Mesh, *, num_microbatches: int,
+def make_hidden_fn(model, mesh: Mesh, *, num_microbatches: int,
                    axis_name: str = "pipeline",
                    data_axes: tuple[str, ...] = ("data",)) -> Callable:
-    """``fn(params, tokens) -> [B, S, V] f32 logits`` with the layer stack
-    pipelined over *axis_name*. *params* is the (boxed or unboxed) tree from
-    ``model.init`` — the scan-stacked "blocks" subtree feeds the schedule;
-    embed/norm/head replicate. Numerics match ``model.apply`` (same modules,
-    functionally applied)."""
+    """``fn(params, tokens) -> [B, S, D] final hidden states`` (post
+    final-norm) with the layer stack pipelined over *axis_name*. *params* is
+    the (boxed or unboxed) tree from ``model.init`` — the scan-stacked
+    "blocks" subtree feeds the schedule; embed/norm replicate."""
     import flax.linen as nn
 
     cfg = model.cfg
@@ -95,10 +94,28 @@ def make_logits_fn(model, mesh: Mesh, *, num_microbatches: int,
             x = x + jnp.take(pos, jnp.arange(tokens.shape[1]), axis=0
                              ).astype(cfg.dtype)
         x = pipe(tp["blocks"], x)
-        x = norm.apply({"params": tp["final_norm"]}, x)
+        return norm.apply({"params": tp["final_norm"]}, x)
+
+    return fn
+
+
+def make_logits_fn(model, mesh: Mesh, *, num_microbatches: int,
+                   axis_name: str = "pipeline",
+                   data_axes: tuple[str, ...] = ("data",)) -> Callable:
+    """``fn(params, tokens) -> [B, S, V] f32 logits`` with the layer stack
+    pipelined over *axis_name*. Numerics match ``model.apply`` (same
+    modules, functionally applied)."""
+    import flax.linen as nn
+
+    cfg = model.cfg
+    hidden = make_hidden_fn(model, mesh, num_microbatches=num_microbatches,
+                            axis_name=axis_name, data_axes=data_axes)
+
+    def fn(params, tokens):
+        x = hidden(params, tokens)
         # One source of truth for the head-weight layout contract.
         from k8s_distributed_deeplearning_tpu.models.llama import unembedding
-        w, layout = unembedding(cfg, params)
+        w, layout = unembedding(cfg, nn.meta.unbox(params))
         if layout == "vd":
             logits = jnp.einsum("bsd,vd->bsv", x, w.astype(cfg.dtype),
                                 preferred_element_type=jnp.float32)
@@ -125,7 +142,8 @@ class PipelineTrainer:
     def __init__(self, model, optimizer: optax.GradientTransformation,
                  mesh: Mesh, *, num_microbatches: int,
                  axis_name: str = "pipeline",
-                 data_axes: tuple[str, ...] = ("data",)):
+                 data_axes: tuple[str, ...] = ("data",),
+                 chunked_ce: bool = False, chunk_size: int = 1024):
         cfg = model.cfg
         _check_supported(cfg)
         stages = mesh.shape[axis_name]
@@ -139,6 +157,11 @@ class PipelineTrainer:
         self.axis_name = axis_name
         self.data_axes = tuple(a for a in data_axes if a in mesh.axis_names)
         self.num_microbatches = num_microbatches
+        self.chunked_ce = chunked_ce
+        self.chunk_size = chunk_size
+        self._hidden_fn = make_hidden_fn(
+            model, mesh, num_microbatches=num_microbatches,
+            axis_name=axis_name, data_axes=data_axes)
         self._logits_fn = make_logits_fn(
             model, mesh, num_microbatches=num_microbatches,
             axis_name=axis_name, data_axes=data_axes)
@@ -175,15 +198,33 @@ class PipelineTrainer:
 
     # -- loss / step -------------------------------------------------------
     def loss_fn(self, params, batch, rng=None):
-        """Shifted next-token CE on pipelined logits; same contract as
-        ``llama.loss_fn`` (mask honored; no packed segments on this path)."""
+        """Shifted next-token CE on pipelined hidden states; same contract as
+        ``llama.loss_fn`` (mask honored; no packed segments on this path).
+        ``chunked_ce=True`` runs the LM head through
+        :func:`ops.chunked_ce.chunked_softmax_cross_entropy` so the
+        ``[B, S, V]`` logits tensor never materializes (the long-vocab
+        memory lever, composed with the pipeline)."""
+        import flax.linen as nn
+        from k8s_distributed_deeplearning_tpu.models.llama import unembedding
+
         _check_supported(self.model.cfg, batch)
         tokens = batch["tokens"]
         inputs, targets = tokens[:, :-1], tokens[:, 1:]
-        logits = self._logits_fn(params, inputs)
         mask = batch.get("mask")
         mask = (jnp.ones_like(targets, jnp.float32) if mask is None
                 else mask[:, 1:])
+
+        if self.chunked_ce:
+            from k8s_distributed_deeplearning_tpu.ops.chunked_ce import (
+                chunked_softmax_cross_entropy)
+            x = self._hidden_fn(params, inputs)
+            w, layout = unembedding(self.model.cfg, nn.meta.unbox(params))
+            loss, acc = chunked_softmax_cross_entropy(
+                x, w, targets, mask, chunk_size=self.chunk_size,
+                w_layout=layout)
+            return loss, {"accuracy": acc, "perplexity": jnp.exp(loss)}
+
+        logits = self._logits_fn(params, inputs)
         ce = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
         loss = (ce * mask).sum() / jnp.maximum(mask.sum(), 1.0)
         acc = (((logits.argmax(-1) == targets) * mask).sum()

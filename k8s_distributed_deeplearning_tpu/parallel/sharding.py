@@ -172,10 +172,26 @@ class ShardedTrainer:
         loss_fn = self.loss_fn
 
         batch_target = dict(rules).get("batch")
-        mb_sh = NamedSharding(mesh, P(None, batch_target))
+        sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+        axes = ((batch_target,) if isinstance(batch_target, str)
+                else tuple(batch_target or ()))
+        shard_count = 1
+        for a in axes:
+            shard_count *= sizes[a]
 
         def constrain(tree: PyTree) -> PyTree:
-            return jax.lax.with_sharding_constraint(tree, mb_sh)
+            # Pin microbatches [m, B/m, ...] with the batch dim sharded — but
+            # only where B/m divides the shard count; an indivisible pin makes
+            # XLA fully rematerialize the tree per microbatch (observed as
+            # "involuntary full rematerialization" resharding), so those
+            # leaves fall back to the unpinned layout (mirrors state_shardings'
+            # divisibility fallback).
+            def one(x):
+                ok = x.ndim >= 2 and x.shape[1] % shard_count == 0
+                spec = P(None, batch_target) if ok else P(None)
+                return jax.lax.with_sharding_constraint(
+                    x, NamedSharding(mesh, spec))
+            return jax.tree.map(one, tree)
 
         def step(state: TrainState, batch: PyTree, rng: jax.Array):
             with nn.logical_axis_rules(rules):  # trace-time rule context

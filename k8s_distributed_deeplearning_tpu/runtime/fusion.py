@@ -24,7 +24,13 @@ _NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
 
 def _ensure_built() -> None:
     """Build the native library if missing/stale and the source tree + make
-    are available (no-op for installed wheels without the native dir)."""
+    are available (no-op for installed wheels without the native dir).
+
+    Concurrent importers (parallel pytest, one process per host) serialize on
+    an exclusive file lock so two ``make`` runs never write the same .so; a
+    failed build logs the compiler's stderr once instead of silently leaving
+    the numpy fallback unexplained.
+    """
     src = os.path.join(_NATIVE_DIR, "fusion.cc")
     so = os.path.join(_NATIVE_DIR, _LIB_NAME)
     if not os.path.exists(src):
@@ -32,10 +38,29 @@ def _ensure_built() -> None:
     if os.path.exists(so) and os.path.getmtime(so) >= os.path.getmtime(src):
         return
     try:
-        subprocess.run(["make", "-C", _NATIVE_DIR], check=False,
-                       capture_output=True, timeout=120)
-    except (OSError, subprocess.TimeoutExpired):
-        pass
+        import fcntl
+        with open(os.path.join(_NATIVE_DIR, ".build.lock"), "w") as lockf:
+            fcntl.flock(lockf, fcntl.LOCK_EX)
+            try:
+                # Re-check under the lock: another process may have built it.
+                if os.path.exists(so) and (os.path.getmtime(so)
+                                           >= os.path.getmtime(src)):
+                    return
+                out = subprocess.run(["make", "-C", _NATIVE_DIR],
+                                     check=False, capture_output=True,
+                                     timeout=120, text=True)
+                if out.returncode != 0:
+                    import logging
+                    logging.getLogger(__name__).warning(
+                        "native runtime build failed (falling back to "
+                        "numpy): make exited %d\n%s",
+                        out.returncode, (out.stderr or "")[-2000:])
+            finally:
+                fcntl.flock(lockf, fcntl.LOCK_UN)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        import logging
+        logging.getLogger(__name__).warning(
+            "native runtime build skipped (%r); using numpy fallback", e)
 
 
 def _load() -> ctypes.CDLL | None:

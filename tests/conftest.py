@@ -15,6 +15,15 @@ flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 
+# Persistent XLA compilation cache: the suite is compile-bound on CPU (one
+# core here), and the same step functions recompile run after run. A warm
+# cache cuts e.g. tests/test_sharding.py from ~136s to ~21s. Safe to share:
+# keys include HLO + flags + backend. Subprocess tests inherit it via env.
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      "/tmp/k8s_ddl_tpu_jax_test_cache")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
+
 # The environment's TPU boot hook (sitecustomize) imports jax at interpreter
 # start and re-pins JAX_PLATFORMS, so env vars alone are too late under pytest
 # — pin the platform on the already-imported config too, and deregister the

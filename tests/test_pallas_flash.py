@@ -171,3 +171,28 @@ def test_flash_segment_ids_validation():
     with pytest.raises(ValueError, match=r"\[B, Sq\]"):
         pallas_flash.flash_attention(q, k, v, q_segment_ids=seg[:, :8],
                                      kv_segment_ids=seg, interpret=True)
+
+
+def test_default_impl_rule():
+    """The impl="auto" crossover rule (measured on v5e, BENCHMARKS.md):
+    flash on TPU at S>=1024 (128-aligned), XLA otherwise and always on CPU."""
+    from k8s_distributed_deeplearning_tpu.ops.attention import default_impl
+    assert default_impl(2048, platform="tpu") == "flash"
+    assert default_impl(1024, platform="axon") == "flash"
+    assert default_impl(512, platform="tpu") == "xla"       # short seq
+    assert default_impl(1100, platform="tpu") == "xla"      # not 128-aligned
+    assert default_impl(4096, platform="cpu") == "xla"      # interpret mode
+    assert default_impl(4096) == "xla"                      # CI runs on CPU
+
+
+def test_auto_impl_dispatches_and_matches():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from k8s_distributed_deeplearning_tpu.ops.attention import (
+        multi_head_attention)
+    q = jax.random.normal(jax.random.key(0), (1, 64, 2, 16))
+    out_auto = multi_head_attention(q, q, q, causal=True, impl="auto")
+    out_xla = multi_head_attention(q, q, q, causal=True, impl="xla")
+    np.testing.assert_allclose(np.asarray(out_auto), np.asarray(out_xla),
+                               atol=1e-6)
