@@ -53,6 +53,10 @@ def main(argv: list[str] | None = None) -> int:
         "--apply", action="store_true",
         help="pipe the manifests into kubectl apply -f -")
     parsers["run-local"].add_argument("--timeout", type=int, default=600)
+    parsers["run-local"].add_argument(
+        "--max-restarts", type=int, default=0,
+        help="elastic reconcile: restart a failed gang up to N times "
+             "(workers resume from their checkpoint dir)")
     args = ap.parse_args(argv)
 
     cfg = JobConfig(name=args.name, namespace=args.namespace,
@@ -80,7 +84,18 @@ def main(argv: list[str] | None = None) -> int:
 
     if args.cmd == "run-local":
         from k8s_distributed_deeplearning_tpu.launch import local_executor
-        results = local_executor.run_local(cfg, timeout=args.timeout)
+        if args.max_restarts:
+            from k8s_distributed_deeplearning_tpu.launch import elastic
+            try:
+                results, n = elastic.run_elastic(
+                    cfg, max_restarts=args.max_restarts, timeout=args.timeout)
+            except RuntimeError as e:
+                print(f"elastic run failed: {e}", file=sys.stderr)
+                return 1
+            if n:
+                print(f"gang restarted {n} time(s)", file=sys.stderr)
+        else:
+            results = local_executor.run_local(cfg, timeout=args.timeout)
         for r in results:
             sys.stdout.write(r.stdout)
             if r.returncode != 0:

@@ -1,0 +1,89 @@
+"""Elastic data parallelism — control-plane reconcile, TPU-native semantics.
+
+The reference ships elasticity only as a doc link to Horovod's elastic
+example (``horovod/README.md:20-22``). Horovod-elastic resizes a live MPI
+world; JAX's distributed runtime (like MPI itself) cannot — membership is
+fixed at ``jax.distributed.initialize``. The TPU-native design moves
+elasticity to the **control plane**, which is where K8s already does it:
+
+- the world size lives in ONE rendered object (the Indexed Job's
+  ``completions``/``parallelism`` + ``TPUJOB_NUM_PROCESSES`` env);
+- when the worker set must change (scale-up, spot eviction, crash), the
+  job is re-rendered at the new size and every worker restarts;
+- state survives through the checkpoint stream, not through live process
+  membership: restore-on-start (``train/loop.py``) is replay-free
+  (``batch_at``) and topology-independent (``tests/test_checkpoint.py``
+  proves cross-topology restore), so a 2-worker run continues as a
+  1- or 4-worker run from the same directory.
+
+:func:`run_elastic` implements that reconcile loop over the local gang
+executor (the no-cluster analog of the controller; on a real cluster the
+same loop is "re-render + ``kubectl apply`` + let the Job restart pods").
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+from k8s_distributed_deeplearning_tpu.config import JobConfig
+from k8s_distributed_deeplearning_tpu.launch.local_executor import (
+    WorkerResult,
+    run_local,
+)
+
+ResizeFn = Callable[[JobConfig, list[WorkerResult]], JobConfig]
+
+
+def resize_to(num_workers: int) -> ResizeFn:
+    """Resize policy: restart at a fixed new world size."""
+    def fn(cfg: JobConfig, _failed: list[WorkerResult]) -> JobConfig:
+        return dataclasses.replace(cfg, num_workers=num_workers)
+    return fn
+
+
+def run_elastic(cfg: JobConfig, *, max_restarts: int = 3,
+                resize: ResizeFn | None = None,
+                extra_env: dict[str, str] | None = None,
+                timeout: int = 600, cwd: str | None = None,
+                on_restart: Callable[[int, JobConfig], None] | None = None,
+                ) -> tuple[list[WorkerResult], int]:
+    """Run the rendered gang to completion, restarting (optionally resized)
+    on failure.
+
+    Each attempt executes the job exactly as rendered (see
+    ``local_executor``). A clean gang (all workers exit 0) returns
+    ``(results, restarts_used)``. On any worker failure the *resize* policy
+    picks the next world size (default: same size — crash recovery), the
+    job is re-rendered, and the new gang resumes from the checkpoint
+    directory the training script was configured with. More than
+    *max_restarts* failed attempts raises, carrying the last gang's stderr.
+    """
+    import subprocess
+
+    restarts = 0
+    while True:
+        try:
+            results = run_local(cfg, extra_env=extra_env, timeout=timeout,
+                                cwd=cwd)
+        except subprocess.TimeoutExpired:
+            # A partially-hung gang (e.g. one worker killed, peers stuck at
+            # a collective) is the canonical eviction mode — it consumes a
+            # restart attempt like any other failure.
+            results = []
+        if results and all(r.returncode == 0 for r in results):
+            return results, restarts
+        restarts += 1
+        if restarts > max_restarts:
+            if not results:
+                raise RuntimeError(
+                    f"gang failed {restarts} times (last attempt timed out "
+                    f"after {timeout}s — workers killed)")
+            failed = [r for r in results if r.returncode != 0]
+            raise RuntimeError(
+                f"gang failed {restarts} times (last: worker "
+                f"{failed[0].index} exited {failed[0].returncode}):\n"
+                + failed[0].stderr[-2000:])
+        if resize is not None:
+            cfg = resize(cfg, results)
+        if on_restart is not None:
+            on_restart(restarts, cfg)

@@ -41,12 +41,28 @@ def generate(model, params: PyTree, prompt: jax.Array, *,
         raise ValueError("temperature sampling requires rng")
     if max_new_tokens < 1:
         raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
-    max_seq = getattr(getattr(model, "cfg", None), "max_seq_len", None)
+    cfg = getattr(model, "cfg", None)
+    max_seq = getattr(cfg, "max_seq_len", None)
     if max_seq is not None and prompt.shape[1] + max_new_tokens > max_seq:
         raise ValueError(
             f"prompt ({prompt.shape[1]}) + max_new_tokens ({max_new_tokens}) "
             f"exceeds the model's max_seq_len ({max_seq}) — the KV cache "
             "would overflow")
+    # Window the KV cache to what this call can actually fill: the cache
+    # buffer (and every decode step's attention) is sized max_seq_len, but a
+    # 64-token generation on an 8k-context model only ever touches the first
+    # prompt+new positions. Shrinking cfg.max_seq_len to a 128-aligned bound
+    # makes each decode step attend O(needed), not O(max context). Safe for
+    # RoPE/none positions (tables are position-indexed, params untouched);
+    # "learned" keeps the full window (its pos-embed param is sized by it).
+    if (max_seq is not None and getattr(cfg, "position", None) != "learned"):
+        import dataclasses
+        need = prompt.shape[1] + max_new_tokens
+        window = min(max_seq, max(128, -(-need // 128) * 128))
+        if window < max_seq:
+            # Module.clone keeps every other field (e.g. MoE configs).
+            model = model.clone(cfg=dataclasses.replace(
+                cfg, max_seq_len=window))
     rng = jax.random.key(0) if rng is None else rng
     return _generate(model, params, prompt, jnp.float32(temperature), rng,
                      greedy=temperature <= 0.0,
