@@ -55,8 +55,11 @@ def generate(model, params: PyTree, prompt: jax.Array, *,
     # makes each decode step attend O(needed), not O(max context). Safe for
     # RoPE/none positions (tables are position-indexed, params untouched);
     # "learned" keeps the full window (its pos-embed param is sized by it).
-    if (max_seq is not None and getattr(cfg, "position", None) != "learned"):
-        import dataclasses
+    import dataclasses
+    if (max_seq is not None and getattr(cfg, "position", None) != "learned"
+            and dataclasses.is_dataclass(cfg) and hasattr(model, "clone")):
+        # (The dataclass/clone guards keep generate()'s duck-typed contract:
+        # a wrapper model with a plain-object config just skips the window.)
         need = prompt.shape[1] + max_new_tokens
         window = min(max_seq, max(128, -(-need // 128) * 128))
         if window < max_seq:

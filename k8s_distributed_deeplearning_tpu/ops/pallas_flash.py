@@ -3,13 +3,19 @@
 The hot op of every transformer config in BASELINE.json. Design follows the
 flash-attention recurrence (online softmax), mapped to TPU:
 
-- grid (batch·heads, S_q/block_q): each program owns one query block in VMEM
-  and streams K/V blocks through the MXU with an f32 accumulator — the S×S
-  score matrix never exists in HBM, so attention becomes compute-bound on the
-  MXU instead of HBM-bandwidth-bound;
-- causal programs stop their K-loop at the diagonal block (trip count is a
-  function of the program id — ``fori_loop`` with a dynamic bound), so the
-  causal forward does ~half the FLOPs, matching the mask's sparsity;
+- grid (batch·heads, S_q/block_q, S_k/superblock): K/V arrive in
+  VMEM-resident SUPERBLOCKS (4096 positions) streamed through the innermost
+  ("arbitrary") grid dim, and the kernel fori_loops over fine blocks inside
+  each with the online-softmax carries in registers. Short sequences
+  (S ≤ superblock) take exactly one grid step — a fully VMEM-resident fast
+  path with zero streaming overhead; longer sequences carry (m, l, acc) in
+  VMEM scratch across superblocks, so VMEM use is O(superblock) and
+  sequence length is bounded by HBM only (64k+ measured on one chip). The
+  S×S score matrix never exists in HBM either way;
+- causal work is skipped twice over: whole superblocks beyond the diagonal
+  frontier skip via ``pl.when``, and the fine-block loop inside clips its
+  trip count to the frontier — the causal pass does ~half the FLOPs,
+  matching the mask's sparsity;
 - the backward pass recomputes P from (Q, K, lse) per block — the standard
   flash trade: O(S) extra FLOPs for never storing P — with separate dQ and
   dK/dV kernels so each accumulates over its own grid without races;
@@ -39,57 +45,82 @@ def _on_tpu() -> bool:
         return False
 
 
+def _pick_block(s: int, target: int) -> int:
+    """Largest power-of-two block ≤ target dividing s."""
+    b = 1
+    while b * 2 <= min(s, target) and s % (b * 2) == 0:
+        b *= 2
+    return b
+
+
 def _block_sizes(sq: int, sk: int, target: int = 512) -> tuple[int, int]:
     """Largest power-of-two block sizes ≤ target dividing the seq lengths."""
-    def pick(s):
-        b = 1
-        while b * 2 <= min(s, target) and s % (b * 2) == 0:
-            b *= 2
-        return b
-    return pick(sq), pick(sk)
+    return _pick_block(sq, target), _pick_block(sk, target)
+
+
+# K/V (and in the dK/dV pass, Q/dO) ride into VMEM in SUPERBLOCKS of this
+# many positions; the kernels fori_loop over fine blocks inside. Short
+# sequences (S <= superblock) hit the fast resident path — one grid step,
+# loop carries in registers; longer sequences stream superblocks through an
+# "arbitrary" grid dim with the online stats in VMEM scratch. 4096 positions
+# x 128 head_dim x bf16 = 1 MiB per tensor per buffer — comfortably inside
+# the ~16 MiB VMEM budget with double buffering.
+_SUPERBLOCK = 4096
+
+
+def _superblock(s: int) -> int:
+    return _pick_block(s, _SUPERBLOCK)
 
 
 # ---------------------------------------------------------------- forward
 
 def _fwd_kernel(q_ref, k_ref, v_ref, *rest,
-                scale: float, causal: bool, block_k: int, seq_k: int,
-                off: int, segments: bool):
+                scale: float, causal: bool, block_k: int, sb: int,
+                n_sb: int, off: int, segments: bool):
+    """One (q-block, K/V-superblock) grid cell. The superblock (sb
+    positions of K and V) is VMEM-resident; the kernel fori_loops over
+    fine ``block_k`` chunks inside it with the online-softmax carries in
+    registers. Short sequences (Sk <= superblock) take exactly one grid
+    step — the fast resident path; longer sequences stream superblocks
+    through the innermost ("arbitrary") grid dim with the (m, l, acc)
+    statistics carried across steps in VMEM scratch, so VMEM use is
+    O(superblock), never O(S)."""
     if segments:
-        segq_ref, segk_ref, o_ref, lse_ref = rest
+        segq_ref, segk_ref, o_ref, lse_ref, m_s, l_s, acc_s = rest
     else:
-        o_ref, lse_ref = rest
+        o_ref, lse_ref, m_s, l_s, acc_s = rest
     qi = pl.program_id(1)
+    kb = pl.program_id(2)
     block_q = q_ref.shape[1]
-    q = q_ref[0].astype(jnp.float32) * scale                    # [bq, d]
+    base = kb * sb                       # first K column of this superblock
+    resident = n_sb == 1                 # static: whole Sk fits one step
+    last_row = qi * block_q + block_q - 1 + off
+    q = q_ref[0].astype(jnp.float32) * scale                      # [bq, d]
 
-    m = jnp.full((block_q,), NEG_INF, jnp.float32)
-    l = jnp.zeros((block_q,), jnp.float32)
-    acc = jnp.zeros((block_q, q.shape[-1]), jnp.float32)
-
-    if causal:
-        # Last K block intersecting the causal triangle of this Q block; the
-        # diagonal sits at col == row + off (off = Sk - Sq, decode alignment,
-        # matching ops/attention.py's reference mask).
-        n_kb = (qi * block_q + block_q - 1 + off) // block_k + 1
-        n_kb = jnp.clip(n_kb, 0, seq_k // block_k)
-    else:
-        n_kb = seq_k // block_k
+    def n_inner():
+        if causal:
+            # Fine blocks inside the superblock up to the causal frontier
+            # (col <= row + off; off = Sk - Sq, the decode alignment
+            # matching ops/attention.py's reference mask).
+            return jnp.clip((last_row - base) // block_k + 1,
+                            0, sb // block_k)
+        return sb // block_k
 
     def body(j, carry):
         m, l, acc = carry
         k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
         v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)  # [bq, bk]
+                                preferred_element_type=jnp.float32)
         if causal:
             row = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, s.shape, 0)
-            col = j * block_k + jax.lax.broadcasted_iota(
+            col = base + j * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, s.shape, 1)
             s = jnp.where(row + off >= col, s, NEG_INF)
         if segments:
             sq_ids = segq_ref[0, 0]                               # [bq]
-            sk_ids = segk_ref[0, 0, pl.ds(j * block_k, block_k)]  # [bk]
+            sk_ids = segk_ref[0, 0, pl.ds(j * block_k, block_k)]
             s = jnp.where(sq_ids[:, None] == sk_ids[None, :], s, NEG_INF)
         bm = jnp.max(s, axis=-1)
         m_new = jnp.maximum(m, bm)
@@ -102,14 +133,45 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest,
             preferred_element_type=jnp.float32)
         return m_new, l_new, acc_new
 
-    m, l, acc = jax.lax.fori_loop(0, n_kb, body, (m, l, acc))
-    norm = jnp.maximum(l, 1e-30)
-    o_ref[0] = (acc / norm[:, None]).astype(o_ref.dtype)
-    lse_ref[0, 0] = m + jnp.log(norm)
+    def emit(m, l, acc):
+        norm = jnp.maximum(l, 1e-30)
+        o_ref[0] = (acc / norm[:, None]).astype(o_ref.dtype)
+        lse_ref[0, 0] = m + jnp.log(norm)
+
+    if resident:
+        # Fast path (statically selected): carries live in registers, no
+        # scratch traffic, no grid predicates — identical to a single-pass
+        # whole-KV kernel.
+        m, l, acc = jax.lax.fori_loop(
+            0, n_inner(),
+            body, (jnp.full((block_q,), NEG_INF, jnp.float32),
+                   jnp.zeros((block_q,), jnp.float32),
+                   jnp.zeros((block_q, q.shape[-1]), jnp.float32)))
+        emit(m, l, acc)
+        return
+
+    @pl.when(kb == 0)
+    def _init():
+        m_s[...] = jnp.full_like(m_s, NEG_INF)
+        l_s[...] = jnp.zeros_like(l_s)
+        acc_s[...] = jnp.zeros_like(acc_s)
+
+    run = base <= last_row if causal else True
+
+    @pl.when(run)
+    def _superblock_body():
+        m, l, acc = jax.lax.fori_loop(
+            0, n_inner(), body, (m_s[...], l_s[...], acc_s[...]))
+        m_s[...], l_s[...], acc_s[...] = m, l, acc
+
+    @pl.when(kb == n_sb - 1)
+    def _emit():
+        emit(m_s[...], l_s[...], acc_s[...])
 
 
-def _seg_specs(h: int, block_q: int, sk: int):
-    """BlockSpecs for segment-id arrays on the (b*h, q-blocks) grid.
+def _seg_specs(h: int, block_q: int, sb_k: int):
+    """BlockSpecs for segment-id arrays on the (b*h, q-blocks,
+    k-superblocks) grid: q ids per q block, k ids per K superblock.
 
     Segments ride as [B, 1, S]: TPU block rules constrain the LAST TWO dims
     (8/128-divisible or full), so a [B, S] layout would make the B dim a
@@ -117,15 +179,27 @@ def _seg_specs(h: int, block_q: int, sk: int):
     length-1 middle dim absorbs that constraint (same trick as lse).
     """
     return [
-        pl.BlockSpec((1, 1, block_q), lambda g, i: (g // h, 0, i)),
-        pl.BlockSpec((1, 1, sk), lambda g, i: (g // h, 0, 0)),
+        pl.BlockSpec((1, 1, block_q), lambda g, i, j: (g // h, 0, i)),
+        pl.BlockSpec((1, 1, sb_k), lambda g, i, j: (g // h, 0, j)),
     ]
+
+
+def _compiler_params(interpret):
+    # batch×heads is embarrassingly parallel; the q/k block dims carry
+    # scratch state across iterations, so they stay sequential.
+    if interpret:
+        return None
+    return pltpu.CompilerParams(
+        dimension_semantics=("parallel", "arbitrary", "arbitrary"))
 
 
 def _fwd(q, k, v, segq, segk, *, causal, scale, interpret):
     b, sq, h, d = q.shape
     sk = k.shape[1]
     block_q, block_k = _block_sizes(sq, sk)
+    sb = _superblock(sk)
+    block_k = min(block_k, sb)      # fine blocks tile WITHIN the superblock
+    n_sb = sk // sb
     # Kernel layout: fold batch×heads, put seq×head_dim innermost.
     qt = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
     kt = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
@@ -133,31 +207,37 @@ def _fwd(q, k, v, segq, segk, *, causal, scale, interpret):
     segments = segq is not None
 
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                               block_k=block_k, seq_k=sk, off=sk - sq,
-                               segments=segments)
+                               block_k=block_k, sb=sb, n_sb=n_sb,
+                               off=sk - sq, segments=segments)
     in_specs = [
-        pl.BlockSpec((1, block_q, d), lambda g, i: (g, i, 0)),
-        pl.BlockSpec((1, sk, d), lambda g, i: (g, 0, 0)),
-        pl.BlockSpec((1, sk, d), lambda g, i: (g, 0, 0)),
+        pl.BlockSpec((1, block_q, d), lambda g, i, j: (g, i, 0)),
+        pl.BlockSpec((1, sb, d), lambda g, i, j: (g, j, 0)),
+        pl.BlockSpec((1, sb, d), lambda g, i, j: (g, j, 0)),
     ]
     operands = [qt, kt, vt]
     if segments:
-        in_specs += _seg_specs(h, block_q, sk)
+        in_specs += _seg_specs(h, block_q, sb)
         operands += [segq[:, None, :], segk[:, None, :]]   # [B,1,S] layout
     o, lse = pl.pallas_call(
         kernel,
-        grid=(b * h, sq // block_q),
+        grid=(b * h, sq // block_q, n_sb),
         in_specs=in_specs,
         out_specs=[
-            pl.BlockSpec((1, block_q, d), lambda g, i: (g, i, 0)),
+            pl.BlockSpec((1, block_q, d), lambda g, i, j: (g, i, 0)),
             # lse rides as [bh, 1, sq]: TPU block rules need the last two dims
             # (8,128)-aligned or full; a (1, block_q) block is neither.
-            pl.BlockSpec((1, 1, block_q), lambda g, i: (g, 0, i)),
+            pl.BlockSpec((1, 1, block_q), lambda g, i, j: (g, 0, i)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
             jax.ShapeDtypeStruct((b * h, 1, sq), jnp.float32),
         ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q,), jnp.float32),       # running max m
+            pltpu.VMEM((block_q,), jnp.float32),       # running sum l
+            pltpu.VMEM((block_q, d), jnp.float32),     # unnormalized acc
+        ],
+        compiler_params=_compiler_params(interpret),
         cost_estimate=pl.CostEstimate(
             flops=4 * b * h * sq * sk * d // (2 if causal else 1),
             bytes_accessed=(qt.size + kt.size + vt.size) * qt.dtype.itemsize,
@@ -170,24 +250,31 @@ def _fwd(q, k, v, segq, segk, *, causal, scale, interpret):
 # ---------------------------------------------------------------- backward
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
-                   scale: float, causal: bool, block_k: int, seq_k: int,
-                   off: int, segments: bool):
+                   scale: float, causal: bool, block_k: int, sb: int,
+                   n_sb: int, off: int, segments: bool):
+    """dQ on the (b*h, q-blocks, K/V-superblocks) grid: the dq accumulator
+    carries across superblocks in VMEM scratch; fine k blocks loop inside
+    the resident superblock (registers)."""
     if segments:
-        segq_ref, segk_ref, dq_ref = rest
+        segq_ref, segk_ref, dq_ref, dq_s = rest
     else:
-        (dq_ref,) = rest
+        dq_ref, dq_s = rest
     qi = pl.program_id(1)
+    kb = pl.program_id(2)
     block_q = q_ref.shape[1]
+    base = kb * sb
+    resident = n_sb == 1
+    last_row = qi * block_q + block_q - 1 + off
     q = q_ref[0].astype(jnp.float32) * scale
     do = do_ref[0].astype(jnp.float32)
     lse = lse_ref[0, 0]
     delta = delta_ref[0, 0]
 
-    if causal:
-        n_kb = (qi * block_q + block_q - 1 + off) // block_k + 1
-        n_kb = jnp.clip(n_kb, 0, seq_k // block_k)
-    else:
-        n_kb = seq_k // block_k
+    def n_inner():
+        if causal:
+            return jnp.clip((last_row - base) // block_k + 1,
+                            0, sb // block_k)
+        return sb // block_k
 
     def body(j, dq):
         k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
@@ -195,8 +282,10 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         if causal:
-            row = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            col = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            row = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 0)
+            col = base + j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 1)
             s = jnp.where(row + off >= col, s, NEG_INF)
         if segments:
             sq_ids = segq_ref[0, 0]
@@ -210,43 +299,68 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
         return dq + jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
                                         preferred_element_type=jnp.float32)
 
-    dq = jax.lax.fori_loop(0, n_kb,
-                           body, jnp.zeros_like(q))
-    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+    if resident:
+        dq = jax.lax.fori_loop(0, n_inner(), body,
+                               jnp.zeros_like(q))
+        dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+        return
+
+    @pl.when(kb == 0)
+    def _init():
+        dq_s[...] = jnp.zeros_like(dq_s)
+
+    run = base <= last_row if causal else True
+
+    @pl.when(run)
+    def _superblock_body():
+        dq_s[...] = jax.lax.fori_loop(0, n_inner(), body, dq_s[...])
+
+    @pl.when(kb == n_sb - 1)
+    def _emit():
+        dq_ref[0] = (dq_s[...] * scale).astype(dq_ref.dtype)
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
-                    scale: float, causal: bool, block_q: int, seq_q: int,
-                    off: int, segments: bool):
+                    scale: float, causal: bool, block_q: int, sb: int,
+                    n_sb: int, off: int, segments: bool):
+    """dK/dV on the (b*h, k-blocks, Q-superblocks) grid: Q/dO/lse/delta
+    stream innermost in superblocks, dk/dv accumulate in VMEM scratch; fine
+    q blocks loop inside the resident superblock."""
     if segments:
-        segq_ref, segk_ref, dk_ref, dv_ref = rest
+        segq_ref, segk_ref, dk_ref, dv_ref, dk_s, dv_s = rest
     else:
-        dk_ref, dv_ref = rest
+        dk_ref, dv_ref, dk_s, dv_s = rest
     ki = pl.program_id(1)
+    qb = pl.program_id(2)
     block_k = k_ref.shape[1]
+    base = qb * sb                     # first Q row of this superblock
+    resident = n_sb == 1
+    first_col = ki * block_k
     k = k_ref[0].astype(jnp.float32)
     v = v_ref[0].astype(jnp.float32)
 
-    if causal:
-        # First Q block intersecting the triangle for this K block: the first
-        # query row that can see col ki*block_k is row = col - off.
-        first_qb = jnp.maximum(ki * block_k - off, 0) // block_q
-        first_qb = jnp.minimum(first_qb, seq_q // block_q)
-    else:
-        first_qb = 0
-    n_qb = seq_q // block_q
+    def first_inner():
+        if causal:
+            # First fine q block inside the superblock whose last row
+            # reaches this k block's first column.
+            return jnp.clip((first_col - off - base) // block_q, 0,
+                            sb // block_q)
+        return 0
 
     def body(i, carry):
         dk, dv = carry
-        q = q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32) * scale
+        q = q_ref[0, pl.ds(i * block_q, block_q), :].astype(
+            jnp.float32) * scale
         do = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
         lse = lse_ref[0, 0, pl.ds(i * block_q, block_q)]
         delta = delta_ref[0, 0, pl.ds(i * block_q, block_q)]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         if causal:
-            row = i * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            col = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            row = base + i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 0)
+            col = first_col + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 1)
             s = jnp.where(row + off >= col, s, NEG_INF)
         if segments:
             sq_ids = segq_ref[0, 0, pl.ds(i * block_q, block_q)]
@@ -263,10 +377,32 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
                                       preferred_element_type=jnp.float32)
         return dk, dv
 
-    dk, dv = jax.lax.fori_loop(first_qb, n_qb, body,
-                               (jnp.zeros_like(k), jnp.zeros_like(v)))
-    dk_ref[0] = dk.astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
+    if resident:
+        dk, dv = jax.lax.fori_loop(first_inner(), sb // block_q, body,
+                                   (jnp.zeros_like(k), jnp.zeros_like(v)))
+        dk_ref[0] = dk.astype(dk_ref.dtype)
+        dv_ref[0] = dv.astype(dv_ref.dtype)
+        return
+
+    @pl.when(qb == 0)
+    def _init():
+        dk_s[...] = jnp.zeros_like(dk_s)
+        dv_s[...] = jnp.zeros_like(dv_s)
+
+    # The superblock contributes iff its LAST row can see this k block's
+    # first column (row + off >= col for some pair).
+    run = base + sb - 1 + off >= first_col if causal else True
+
+    @pl.when(run)
+    def _superblock_body():
+        dk, dv = jax.lax.fori_loop(first_inner(), sb // block_q, body,
+                                   (dk_s[...], dv_s[...]))
+        dk_s[...], dv_s[...] = dk, dv
+
+    @pl.when(qb == n_sb - 1)
+    def _emit():
+        dk_ref[0] = dk_s[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_s[...].astype(dv_ref.dtype)
 
 
 def _bwd(causal, scale, interpret, res, g):
@@ -274,6 +410,9 @@ def _bwd(causal, scale, interpret, res, g):
     b, sq, h, d = q.shape
     sk = k.shape[1]
     block_q, block_k = _block_sizes(sq, sk)
+    sb_k, sb_q = _superblock(sk), _superblock(sq)
+    block_k = min(block_k, sb_k)    # fine blocks tile WITHIN the superblock
+    block_q = min(block_q, sb_q)
     segments = segq is not None
 
     fold = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
@@ -283,57 +422,65 @@ def _bwd(causal, scale, interpret, res, g):
                     * fold(o).astype(jnp.float32), axis=-1)[:, None, :]  # [bh,1,sq]
 
     dq_specs = [
-        pl.BlockSpec((1, block_q, d), lambda g_, i: (g_, i, 0)),
-        pl.BlockSpec((1, sk, d), lambda g_, i: (g_, 0, 0)),
-        pl.BlockSpec((1, sk, d), lambda g_, i: (g_, 0, 0)),
-        pl.BlockSpec((1, block_q, d), lambda g_, i: (g_, i, 0)),
-        pl.BlockSpec((1, 1, block_q), lambda g_, i: (g_, 0, i)),
-        pl.BlockSpec((1, 1, block_q), lambda g_, i: (g_, 0, i)),
+        pl.BlockSpec((1, block_q, d), lambda g_, i, j: (g_, i, 0)),
+        pl.BlockSpec((1, sb_k, d), lambda g_, i, j: (g_, j, 0)),
+        pl.BlockSpec((1, sb_k, d), lambda g_, i, j: (g_, j, 0)),
+        pl.BlockSpec((1, block_q, d), lambda g_, i, j: (g_, i, 0)),
+        pl.BlockSpec((1, 1, block_q), lambda g_, i, j: (g_, 0, i)),
+        pl.BlockSpec((1, 1, block_q), lambda g_, i, j: (g_, 0, i)),
     ]
     dq_operands = [qt, kt, vt, dot, lse, delta]
     if segments:
-        dq_specs += _seg_specs(h, block_q, sk)
+        dq_specs += _seg_specs(h, block_q, sb_k)
         dq_operands += [segq[:, None, :], segk[:, None, :]]
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                          block_k=block_k, seq_k=sk, off=sk - sq,
-                          segments=segments),
-        grid=(b * h, sq // block_q),
+                          block_k=block_k, sb=sb_k, n_sb=sk // sb_k,
+                          off=sk - sq, segments=segments),
+        grid=(b * h, sq // block_q, sk // sb_k),
         in_specs=dq_specs,
-        out_specs=pl.BlockSpec((1, block_q, d), lambda g_, i: (g_, i, 0)),
+        out_specs=pl.BlockSpec((1, block_q, d), lambda g_, i, j: (g_, i, 0)),
         out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=_compiler_params(interpret),
         interpret=interpret,
     )(*dq_operands)
 
+    # dK/dV: k blocks in the middle grid dim, Q superblocks stream innermost.
     dkv_specs = [
-        pl.BlockSpec((1, sq, d), lambda g_, j: (g_, 0, 0)),
-        pl.BlockSpec((1, block_k, d), lambda g_, j: (g_, j, 0)),
-        pl.BlockSpec((1, block_k, d), lambda g_, j: (g_, j, 0)),
-        pl.BlockSpec((1, sq, d), lambda g_, j: (g_, 0, 0)),
-        pl.BlockSpec((1, 1, sq), lambda g_, j: (g_, 0, 0)),
-        pl.BlockSpec((1, 1, sq), lambda g_, j: (g_, 0, 0)),
+        pl.BlockSpec((1, sb_q, d), lambda g_, j, i: (g_, i, 0)),
+        pl.BlockSpec((1, block_k, d), lambda g_, j, i: (g_, j, 0)),
+        pl.BlockSpec((1, block_k, d), lambda g_, j, i: (g_, j, 0)),
+        pl.BlockSpec((1, sb_q, d), lambda g_, j, i: (g_, i, 0)),
+        pl.BlockSpec((1, 1, sb_q), lambda g_, j, i: (g_, 0, i)),
+        pl.BlockSpec((1, 1, sb_q), lambda g_, j, i: (g_, 0, i)),
     ]
     dkv_operands = [qt, kt, vt, dot, lse, delta]
     if segments:
         dkv_specs += [
-            pl.BlockSpec((1, 1, sq), lambda g_, j: (g_ // h, 0, 0)),
-            pl.BlockSpec((1, 1, block_k), lambda g_, j: (g_ // h, 0, j)),
+            pl.BlockSpec((1, 1, sb_q), lambda g_, j, i: (g_ // h, 0, i)),
+            pl.BlockSpec((1, 1, block_k), lambda g_, j, i: (g_ // h, 0, j)),
         ]
         dkv_operands += [segq[:, None, :], segk[:, None, :]]
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                          block_q=block_q, seq_q=sq, off=sk - sq,
-                          segments=segments),
-        grid=(b * h, sk // block_k),
+                          block_q=block_q, sb=sb_q, n_sb=sq // sb_q,
+                          off=sk - sq, segments=segments),
+        grid=(b * h, sk // block_k, sq // sb_q),
         in_specs=dkv_specs,
         out_specs=[
-            pl.BlockSpec((1, block_k, d), lambda g_, j: (g_, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda g_, j: (g_, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda g_, j, i: (g_, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda g_, j, i: (g_, j, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b * h, sk, d), k.dtype),
             jax.ShapeDtypeStruct((b * h, sk, d), v.dtype),
         ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        compiler_params=_compiler_params(interpret),
         interpret=interpret,
     )(*dkv_operands)
 
