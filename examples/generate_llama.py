@@ -35,6 +35,11 @@ def main(argv: list[str] | None = None) -> dict:
                          "single zero token")
     ap.add_argument("--max-new-tokens", type=int, default=64)
     ap.add_argument("--temperature", type=float, default=0.0)
+    ap.add_argument("--top-k", type=int, default=None,
+                    help="sample only from the k most likely tokens")
+    ap.add_argument("--top-p", type=float, default=None,
+                    help="nucleus sampling: smallest set reaching this "
+                         "probability mass")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--seq-len", type=int, default=None)
     ap.add_argument("--dtype", default="float32", choices=["float32", "bfloat16"])
@@ -67,6 +72,7 @@ def main(argv: list[str] | None = None) -> dict:
     out = gen_lib.generate(model, params, prompt,
                            max_new_tokens=args.max_new_tokens,
                            temperature=args.temperature,
+                           top_k=args.top_k, top_p=args.top_p,
                            rng=jax.random.key(args.seed))
     toks = np.asarray(out)[0].tolist()
     text = bytes(t % 256 for t in toks).decode("utf-8", errors="replace")

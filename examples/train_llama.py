@@ -103,6 +103,13 @@ def main(argv: list[str] | None = None) -> dict:
                         help="checkpoint each block (long-context memory lever)")
     parser.add_argument("--data-path", type=str, default=None,
                         help="byte-level corpus file; default synthetic tokens")
+    parser.add_argument("--pack", action="store_true",
+                        help="pack variable-length documents into fixed rows "
+                        "with segment ids (segment-masked attention, "
+                        "per-document RoPE, padding out of the loss)")
+    parser.add_argument("--pack-sep-id", type=int, default=None,
+                        help="document separator token id for --pack "
+                        "(default: seeded pseudo-document splits)")
     parser.add_argument("--chunked-ce", dest="chunked_ce", action="store_true",
                         default=None,
                         help="chunked LM-head loss (never materializes "
@@ -215,10 +222,26 @@ def main(argv: list[str] | None = None) -> dict:
             f"--batch-size {global_batch} (global) must divide evenly across "
             f"{topo.num_processes} processes")
     per_host = global_batch // topo.num_processes
-    batcher = data_lib.TokenBatcher(tokens, per_host, seq_len,
-                                    seed=conf.seed,
-                                    process_index=topo.process_index,
-                                    num_processes=topo.num_processes)
+    if args.pack:
+        if use_cp:
+            raise ValueError("--pack (segment ids) is not supported with "
+                             "context-parallel attention yet")
+        if use_pp:
+            raise ValueError("--pack is not supported with --pp yet")
+        docs = data_lib.split_documents(tokens, args.pack_sep_id,
+                                        seed=conf.seed)
+        batcher = data_lib.PackedTokenBatcher(
+            docs, per_host, seq_len, seed=conf.seed,
+            process_index=topo.process_index,
+            num_processes=topo.num_processes)
+        metrics_extra = {"packing_efficiency":
+                         round(batcher.packing_efficiency, 4)}
+    else:
+        batcher = data_lib.TokenBatcher(tokens, per_host, seq_len,
+                                        seed=conf.seed,
+                                        process_index=topo.process_index,
+                                        num_processes=topo.num_processes)
+        metrics_extra = {}
 
     metrics = MetricsLogger(enabled=distributed.is_primary(), job="llama")
     ckpt = Checkpointer(conf.checkpoint_dir,
@@ -236,6 +259,7 @@ def main(argv: list[str] | None = None) -> dict:
                  attention=args.attention,
                  **({"cp_impl": cp_impl, "cp_inner": cp_inner}
                     if cp_impl else {}),
+                 **metrics_extra,
                  platform=topo.platform)
 
     prefetchers: list = []

@@ -25,20 +25,62 @@ import jax.numpy as jnp
 PyTree = Any
 
 
+def filter_logits(logits: jax.Array, top_k: int | None = None,
+                  top_p: float | None = None) -> jax.Array:
+    """Top-k / nucleus (top-p) filtering on a [..., V] logits slice: tokens
+    outside the k most likely, and outside the smallest set whose
+    probability mass reaches *top_p*, get -inf. The highest-probability
+    token always survives. Composable (k first, then p — the usual order).
+    """
+    if top_k is None and (top_p is None or top_p >= 1.0):
+        return logits
+    # One descending sort serves both filters (V can be 128k — don't sort
+    # the hot decode loop twice).
+    sorted_desc = jnp.flip(jnp.sort(logits, axis=-1), axis=-1)
+    if top_k is not None and top_k > 0:
+        kth = sorted_desc[..., min(top_k, logits.shape[-1]) - 1, None]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+        sorted_desc = jnp.where(
+            jnp.arange(sorted_desc.shape[-1]) < top_k, sorted_desc, -jnp.inf)
+    if top_p is not None and top_p < 1.0:
+        probs = jax.nn.softmax(sorted_desc, axis=-1)
+        # Keep a sorted token while the mass BEFORE it is < top_p, so the
+        # first token is always kept and the kept set is the smallest one
+        # reaching the target mass. max(·, 1) keeps the argmax even for
+        # top_p <= 0 from direct callers.
+        exclusive = jnp.cumsum(probs, axis=-1) - probs
+        n_keep = jnp.maximum(
+            jnp.sum(exclusive < top_p, axis=-1, keepdims=True), 1)
+        thresh = jnp.take_along_axis(sorted_desc, n_keep - 1, axis=-1)
+        logits = jnp.where(logits < thresh, -jnp.inf, logits)
+    return logits
+
+
 def generate(model, params: PyTree, prompt: jax.Array, *,
              max_new_tokens: int, rng: jax.Array | None = None,
-             temperature: float = 0.0, eos_id: int | None = None,
+             temperature: float = 0.0, top_k: int | None = None,
+             top_p: float | None = None, eos_id: int | None = None,
              pad_id: int = 0) -> jax.Array:
     """Generate ``max_new_tokens`` continuations of ``prompt`` ([B, S] int32).
 
     ``temperature=0`` is greedy argmax; otherwise categorical sampling with
-    logits/temperature (requires *rng*). Returns [B, max_new_tokens] int32.
-    Prompt + new tokens must fit the model's ``max_seq_len``. Only the
-    greedy/sampling CHOICE is compile-time; the temperature value itself is a
-    traced operand, so sweeping temperatures reuses one compiled program.
+    logits/temperature, optionally restricted by ``top_k`` and/or nucleus
+    ``top_p`` filtering (``filter_logits``; requires *rng*). Returns
+    [B, max_new_tokens] int32. Prompt + new tokens must fit the model's
+    ``max_seq_len``. Only the greedy/sampling CHOICE is compile-time; the
+    temperature value itself is a traced operand, so sweeping temperatures
+    reuses one compiled program.
     """
     if temperature > 0.0 and rng is None:
         raise ValueError("temperature sampling requires rng")
+    if top_p is not None and not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+    if top_k is not None and top_k < 1:
+        raise ValueError(f"top_k must be >= 1, got {top_k}")
+    if temperature <= 0.0 and (top_k is not None or top_p is not None):
+        raise ValueError(
+            "top_k/top_p require temperature > 0 (greedy decoding ignores "
+            "them — silently dropping the request would mislead)")
     if max_new_tokens < 1:
         raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
     cfg = getattr(model, "cfg", None)
@@ -70,16 +112,17 @@ def generate(model, params: PyTree, prompt: jax.Array, *,
     return _generate(model, params, prompt, jnp.float32(temperature), rng,
                      greedy=temperature <= 0.0,
                      max_new_tokens=max_new_tokens, eos_id=eos_id,
-                     pad_id=pad_id)
+                     pad_id=pad_id, top_k=top_k, top_p=top_p)
 
 
 @functools.partial(jax.jit, static_argnames=("model", "greedy",
                                              "max_new_tokens", "eos_id",
-                                             "pad_id"))
+                                             "pad_id", "top_k", "top_p"))
 def _generate(model, params: PyTree, prompt: jax.Array,
               temperature: jax.Array, rng: jax.Array, *, greedy: bool,
               max_new_tokens: int, eos_id: int | None,
-              pad_id: int) -> jax.Array:
+              pad_id: int, top_k: int | None = None,
+              top_p: float | None = None) -> jax.Array:
     # Prefill: run the prompt through decode mode, filling the cache.
     logits, vars_ = model.apply({"params": params}, prompt, decode=True,
                                 mutable=["cache"])
@@ -87,8 +130,9 @@ def _generate(model, params: PyTree, prompt: jax.Array,
 
     def sample(logits_last, step_rng):
         if not greedy:
-            return jax.random.categorical(step_rng,
-                                          logits_last / temperature, axis=-1)
+            logits_t = filter_logits(logits_last / temperature,
+                                     top_k=top_k, top_p=top_p)
+            return jax.random.categorical(step_rng, logits_t, axis=-1)
         return jnp.argmax(logits_last, axis=-1)
 
     rng, r0 = jax.random.split(rng)

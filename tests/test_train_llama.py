@@ -85,6 +85,27 @@ def test_train_llama_pipeline_cli(tmp_path):
     assert result["eval_loss"] < 5.0
 
 
+@pytest.mark.slow
+def test_train_llama_packed_cli(tmp_path):
+    """--pack: packed-document training through the full CLI (segment-masked
+    attention + per-document RoPE + loss masking under the sharded step)."""
+    import train_llama
+    result = train_llama.main([
+        "--preset", "tiny", "--dp", "8", "--pack",
+        "--num-steps", "10", "--batch-size", "8", "--seq-len", "128",
+        "--log-every", "5", "--checkpoint-dir", str(tmp_path / "ck"),
+        "--checkpoint-every", "1000",
+    ])
+    assert result["num_steps"] == 10
+
+
+def test_train_llama_pack_flag_conflicts():
+    import train_llama
+    with pytest.raises(ValueError, match="--pack"):
+        train_llama.main(["--preset", "tiny", "--pack", "--pp", "2",
+                          "--num-steps", "1"])
+
+
 def test_train_llama_pp_flag_conflicts():
     import train_llama
     with pytest.raises(ValueError, match="--pp composes with --dp only"):
