@@ -245,7 +245,8 @@ def main(argv: list[str] | None = None) -> dict:
 
     metrics = MetricsLogger(enabled=distributed.is_primary(), job="llama")
     ckpt = Checkpointer(conf.checkpoint_dir,
-                        max_to_keep=conf.max_checkpoints_to_keep)
+                        max_to_keep=conf.max_checkpoints_to_keep,
+                        async_save=conf.async_checkpoint)
     preemption = PreemptionHandler.install()
     profiler = (StepProfiler(args.profile_dir, start_step=10, num_steps=5,
                              enabled=distributed.is_primary())

@@ -99,7 +99,8 @@ def main(argv: list[str] | None = None) -> dict:
     ckpt = Checkpointer(conf.checkpoint_dir,
                         max_to_keep=conf.max_checkpoints_to_keep,
                         keep_best_metric="accuracy" if conf.keep_best else None,
-                        best_mode="max")
+                        best_mode="max",
+                        async_save=conf.async_checkpoint)
 
     # Mid-training validation hook (Keras per-epoch eval parity,
     # tensorflow_mnist_gpu.py:173-182); feeds best-checkpoint retention.

@@ -126,7 +126,8 @@ def main(argv: list[str] | None = None) -> dict:
     metrics = MetricsLogger(enabled=distributed.is_primary(),
                             job=f"zoo-{args.model}")
     ckpt = Checkpointer(conf.checkpoint_dir,
-                        max_to_keep=conf.max_checkpoints_to_keep)
+                        max_to_keep=conf.max_checkpoints_to_keep,
+                        async_save=conf.async_checkpoint)
     rng = jax.random.key(conf.seed)
     prefetchers: list = []
 

@@ -43,6 +43,9 @@ class TrainConfig:
     # tensorflow_mnist_gpu.py:160-163,173-182). eval_every=0 disables.
     eval_every: int = 0
     keep_best: bool = False
+    # Async checkpoint writes: device->host snapshot is synchronous (safe
+    # with donated train states), serialization/IO overlaps training.
+    async_checkpoint: bool = False
 
     # Data
     data_dir: str | None = None      # MNIST idx files; None -> synthetic
@@ -146,6 +149,10 @@ def add_train_flags(parser: argparse.ArgumentParser,
     parser.add_argument("--log-every", type=int, default=d.log_every)
     parser.add_argument("--checkpoint-dir", type=str, default=d.checkpoint_dir)
     parser.add_argument("--checkpoint-every", type=int, default=d.checkpoint_every)
+    parser.add_argument("--async-checkpoint", dest="async_checkpoint",
+                        action="store_true", default=d.async_checkpoint,
+                        help="overlap checkpoint serialization/IO with "
+                             "training (snapshot itself stays synchronous)")
     parser.add_argument("--data-dir", type=str, default=d.data_dir)
     parser.add_argument("--dtype", type=str, default=d.dtype,
                         choices=["float32", "bfloat16"])

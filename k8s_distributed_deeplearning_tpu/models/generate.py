@@ -34,8 +34,12 @@ def filter_logits(logits: jax.Array, top_k: int | None = None,
     """
     if top_k is None and (top_p is None or top_p >= 1.0):
         return logits
-    # One descending sort serves both filters (V can be 128k — don't sort
-    # the hot decode loop twice).
+    if top_p is None or top_p >= 1.0:
+        # top_k only: lax.top_k retrieves k values without sorting the full
+        # (possibly 128k-wide) vocab in the per-token decode loop.
+        kvals, _ = jax.lax.top_k(logits, min(top_k, logits.shape[-1]))
+        return jnp.where(logits < kvals[..., -1, None], -jnp.inf, logits)
+    # Both filters: one descending sort serves top-k and the nucleus scan.
     sorted_desc = jnp.flip(jnp.sort(logits, axis=-1), axis=-1)
     if top_k is not None and top_k > 0:
         kth = sorted_desc[..., min(top_k, logits.shape[-1]) - 1, None]

@@ -38,9 +38,10 @@ class Checkpointer:
 
     def __init__(self, directory: str, max_to_keep: int = 3,
                  keep_best_metric: str | None = None,
-                 best_mode: str = "max"):
+                 best_mode: str = "max", async_save: bool = False):
         self.directory = os.path.abspath(directory)
         self.keep_best_metric = keep_best_metric
+        self.async_save = async_save
         best_kw = {}
         if keep_best_metric is not None:
             best_kw = dict(
@@ -59,10 +60,21 @@ class Checkpointer:
 
     def save(self, step: int, state: PyTree, force: bool = False,
              metrics: dict | None = None) -> bool:
+        """Save *state* at *step*. With ``async_save`` the device arrays are
+        snapshotted synchronously but serialization/IO runs on Orbax's
+        background thread — the train loop keeps stepping while the previous
+        checkpoint writes (Orbax itself serializes overlapping saves).
+        Synchronous mode (default) blocks until the write is durable."""
         saved = self._mgr.save(step, args=ocp.args.StandardSave(state),
                                force=force, metrics=metrics)
-        self._mgr.wait_until_finished()
+        if not self.async_save:
+            self._mgr.wait_until_finished()
         return saved
+
+    def wait(self) -> None:
+        """Block until outstanding async saves are durable (no-op when
+        synchronous)."""
+        self._mgr.wait_until_finished()
 
     def best_step(self) -> int | None:
         """Step of the best checkpoint by the tracked metric (None when not
@@ -156,4 +168,5 @@ class Checkpointer:
         return jax.device_put(collapse(restored[key]), sharding), step
 
     def close(self) -> None:
+        self._mgr.wait_until_finished()   # drain async saves before closing
         self._mgr.close()

@@ -145,11 +145,16 @@ class _EpochShardedBatcher:
         self.process_index = process_index
         self.num_processes = num_processes
         self.num_items = num_items
-        shard_len = len(range(process_index, num_items, num_processes))
-        self._bpe = shard_len // batch_size
+        # bpe derives from the MINIMUM per-host shard (num_items //
+        # num_processes), not this host's own stride length: hosts whose
+        # shards differ by one would otherwise disagree on the epoch
+        # boundary, draw from different epoch permutations at the same step,
+        # and break the disjointness guarantee.
+        min_shard = num_items // num_processes
+        self._bpe = min_shard // batch_size
         if self._bpe == 0:
             raise ValueError(
-                f"per-host shard ({shard_len} {what}) is smaller than "
+                f"per-host shard ({min_shard} {what}) is smaller than "
                 f"batch_size={batch_size}")
         self._epoch_cache: tuple[int, np.ndarray] | None = None
 

@@ -197,3 +197,21 @@ def test_restore_params_empty_returns_none(tmp_path):
     ck = Checkpointer(str(tmp_path / "nothing"))
     assert ck.restore_params() is None
     ck.close()
+
+
+def test_async_save_roundtrip(tmp_path):
+    """async_save: saves overlap the caller; wait()/close() drain; the
+    restored state is the snapshot taken at save time (not a later
+    mutation)."""
+    import numpy as np
+    ckpt = Checkpointer(str(tmp_path / "ck"), max_to_keep=2, async_save=True)
+    state = {"params": {"w": jnp.full((64, 64), 1.0)}, "step": jnp.asarray(1)}
+    ckpt.save(1, state)
+    ckpt.save(2, {"params": {"w": jnp.full((64, 64), 2.0)},
+                  "step": jnp.asarray(2)})
+    ckpt.wait()
+    restored, step = ckpt.restore_latest(state)
+    assert step == 2
+    np.testing.assert_allclose(restored["params"]["w"],
+                               np.full((64, 64), 2.0))
+    ckpt.close()
