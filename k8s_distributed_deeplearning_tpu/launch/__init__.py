@@ -5,3 +5,16 @@ from k8s_distributed_deeplearning_tpu.launch.render import (  # noqa: F401
     render_all,
     to_yaml,
 )
+from k8s_distributed_deeplearning_tpu.launch.validate import (  # noqa: F401
+    kubectl_validate,
+    validate,
+    validate_or_raise,
+)
+from k8s_distributed_deeplearning_tpu.launch.local_executor import (  # noqa: F401
+    WorkerResult,
+    run_local,
+)
+from k8s_distributed_deeplearning_tpu.launch.elastic import (  # noqa: F401
+    resize_to,
+    run_elastic,
+)

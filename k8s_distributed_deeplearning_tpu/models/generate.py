@@ -32,7 +32,7 @@ def filter_logits(logits: jax.Array, top_k: int | None = None,
     probability mass reaches *top_p*, get -inf. The highest-probability
     token always survives. Composable (k first, then p — the usual order).
     """
-    if top_k is None and (top_p is None or top_p >= 1.0):
+    if (top_k is None or top_k <= 0) and (top_p is None or top_p >= 1.0):
         return logits
     if top_p is None or top_p >= 1.0:
         # top_k only: lax.top_k retrieves k values without sorting the full

@@ -28,3 +28,6 @@ from k8s_distributed_deeplearning_tpu.parallel.pipeline import (  # noqa: F401
     make_pipeline_fn,
     pipeline_apply,
 )
+from k8s_distributed_deeplearning_tpu.parallel.pipeline_lm import (  # noqa: F401
+    PipelineTrainer,
+)

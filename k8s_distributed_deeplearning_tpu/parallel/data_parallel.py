@@ -244,6 +244,13 @@ def broadcast_params(params: PyTree, mesh: Mesh, axis_name: str = "data",
     value; every process's copy is staged onto its own devices (so divergent
     hosts really contribute divergent shards), and a masked psum selects the
     value held by mesh position ``root`` for everyone.
+
+    Memory scope: staging holds one full params copy per device plus the
+    replicated output (peak ~2x params per device) — sized for the
+    replicated-DP models this engine serves. Models that only fit sharded
+    (the 8B config) initialize through ``ShardedTrainer.init`` /
+    checkpoint restore instead, where every host constructs identical
+    shards by construction and no broadcast is needed.
     """
     import numpy as np
 

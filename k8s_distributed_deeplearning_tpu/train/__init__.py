@@ -1,9 +1,11 @@
 """Training loop, data pipeline, checkpointing, preemption, prefetch."""
 
 from k8s_distributed_deeplearning_tpu.train.data import (  # noqa: F401
+    PackedTokenBatcher,
     ShardedBatcher,
     TokenBatcher,
     load_mnist,
+    split_documents,
     synthetic_images,
     synthetic_mnist,
     synthetic_tokens,

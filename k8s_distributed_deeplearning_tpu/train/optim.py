@@ -53,7 +53,12 @@ def make_optimizer(name: str, lr, *, weight_decay: float = 0.1,
         # store O(rows+cols) per matrix instead of O(rows*cols) — for the 8B
         # config that's ~16 GB of optimizer state saved vs adam(w), often
         # the difference between fitting a slice and not.
-        tx = optax.adafactor(lr, weight_decay_rate=weight_decay or None)
+        # No weight decay here: optax.adafactor applies weight_decay_rate
+        # AFTER lr scaling (a raw fraction-per-step shrink), so forwarding
+        # the adamw-style 0.1 would collapse params in ~50 steps. Decay for
+        # adafactor runs should be composed explicitly with an lr-scaled
+        # rate by the caller.
+        tx = optax.adafactor(lr)
     elif name == "lion":
         tx = optax.lion(lr, weight_decay=weight_decay)
     else:
