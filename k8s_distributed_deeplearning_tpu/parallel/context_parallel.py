@@ -76,11 +76,12 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     n = lax.axis_size(axis_name)
     r = lax.axis_index(axis_name)
     hq, hkv = q.shape[2], k.shape[2]
-    k = _repeat_kv(k, hq // hkv)
-    v = _repeat_kv(v, hq // hkv)
+    # GQA: K/V rotate around the ring UNEXPANDED (hq/hkv x less ppermute
+    # traffic on ICI); heads expand locally right before each block attend.
+    g_rep = hq // hkv
     scale = softmax_scale if softmax_scale is not None else q.shape[-1] ** -0.5
     sq, sk = q.shape[1], k.shape[1]
-    b, h = q.shape[0], q.shape[2]
+    b, h = q.shape[0], hq
 
     o = jnp.zeros((b, sq, h, q.shape[-1]), jnp.float32)
     l = jnp.zeros((b, h, sq), jnp.float32)
@@ -98,7 +99,8 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
             mask = (r * sq + row) >= (src * sk + col)
         else:
             mask = None
-        bo, bl, bm = _block_attend(q, k, v, mask, scale)
+        bo, bl, bm = _block_attend(q, _repeat_kv(k, g_rep),
+                                   _repeat_kv(v, g_rep), mask, scale)
         m_new = jnp.maximum(m, bm)
         alpha = jnp.exp(m - m_new)        # rescale old accumulator
         beta = jnp.exp(bm - m_new)        # rescale incoming block
