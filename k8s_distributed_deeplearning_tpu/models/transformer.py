@@ -35,6 +35,16 @@ Dtype = Any
 default_init = nn.initializers.xavier_uniform
 embed_init = nn.initializers.normal(stddev=0.02)
 
+# Rematerialization policies (config knob `remat_policy`): "dots" keeps
+# matmul outputs through remat (skips recomputing the MXU work — measured
+# faster at long context, BENCHMARKS.md); "nothing" recomputes everything
+# (minimal memory). Shared by the scan/remat stack here and the pipeline
+# engine's per-layer checkpointing (parallel/pipeline_lm.py).
+REMAT_POLICIES = {
+    "dots": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+    "nothing": jax.checkpoint_policies.nothing_saveable,
+}
+
 
 @dataclasses.dataclass(frozen=True)
 class TransformerConfig:
@@ -59,8 +69,17 @@ class TransformerConfig:
                                         # auto = measured per-platform/seq-len
                                         # rule (ops.attention.default_impl)
     remat: bool = False                 # checkpoint each block
+    remat_policy: str = "dots"          # "dots" (keep matmul outputs —
+                                        # measured slightly faster) |
+                                        # "nothing" (minimal memory)
     scan_layers: bool = True            # stack layers via nn.scan
     dropout_rate: float = 0.0
+
+    def __post_init__(self):
+        if self.remat_policy not in REMAT_POLICIES:
+            raise ValueError(
+                f"remat_policy must be one of {sorted(REMAT_POLICIES)}, "
+                f"got {self.remat_policy!r}")
 
     @property
     def resolved_head_dim(self) -> int:
@@ -377,7 +396,7 @@ class Transformer(nn.Module):
             block_cls = nn.remat(
                 Block, prevent_cse=False,
                 static_argnums=(),
-                policy=jax.checkpoint_policies.nothing_saveable)
+                policy=REMAT_POLICIES[cfg.remat_policy])
         # Pass decode only when set: under nn.remat every call argument is
         # traced, which would turn the static `decode` python bool into a
         # tracer (remat is never combined with decode — guarded above).

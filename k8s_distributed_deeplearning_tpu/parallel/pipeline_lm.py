@@ -47,7 +47,11 @@ def block_fn_from_config(cfg: tfm.TransformerConfig) -> Callable:
     def block_fn(layer_params, x):
         return block.apply({"params": layer_params}, x)
 
-    return jax.checkpoint(block_fn) if cfg.remat else block_fn
+    if cfg.remat:
+        # Same policy knob as the scan/remat stack (cfg.remat_policy).
+        return jax.checkpoint(block_fn,
+                              policy=tfm.REMAT_POLICIES[cfg.remat_policy])
+    return block_fn
 
 
 def _check_supported(cfg: tfm.TransformerConfig, batch: PyTree | None = None):

@@ -188,3 +188,28 @@ def test_packed_loss_equals_separate_document_loss():
     cb, nb = doc_ce(b)
     expected = (float(ca) + float(cb)) / (na + nb)
     np.testing.assert_allclose(float(loss_packed), expected, rtol=1e-5)
+
+
+def test_remat_policy_variants():
+    """Remat policies only change what the BACKWARD saves — compare loss
+    AND grads against the no-remat reference for every policy."""
+    import dataclasses
+    import pytest
+    from k8s_distributed_deeplearning_tpu.models import llama
+
+    base = llama.config_tiny(dtype=jnp.float32, remat=True)
+    ref_model = llama.LlamaLM(llama.config_tiny(dtype=jnp.float32))
+    toks = jax.random.randint(jax.random.key(0), (2, 17), 0, 256)
+    params = ref_model.init(jax.random.key(1), toks[:, :8])["params"]
+    batch = {"tokens": toks}
+    (ref_loss, _), ref_grads = jax.value_and_grad(
+        lambda p: llama.loss_fn(ref_model, p, batch), has_aux=True)(params)
+    for policy in ("dots", "nothing"):
+        m = llama.LlamaLM(dataclasses.replace(base, remat_policy=policy))
+        (loss, _), grads = jax.value_and_grad(
+            lambda p: llama.loss_fn(m, p, batch), has_aux=True)(params)
+        np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-6)
+        jax.tree.map(lambda a, b: np.testing.assert_allclose(
+            a, b, rtol=2e-5, atol=2e-6), grads, ref_grads)
+    with pytest.raises(ValueError, match="remat_policy"):
+        dataclasses.replace(base, remat_policy="bogus")
