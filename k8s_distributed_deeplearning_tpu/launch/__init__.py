@@ -5,11 +5,15 @@ from k8s_distributed_deeplearning_tpu.launch.render import (  # noqa: F401
     render_all,
     to_yaml,
 )
+# NOTE: the `validate` FUNCTION is deliberately not re-exported at package
+# level — it would shadow the `launch.validate` MODULE in this namespace
+# (breaking `from ...launch import validate` module imports). Use
+# `launch.validate.validate(docs)` or `validate_or_raise` below.
 from k8s_distributed_deeplearning_tpu.launch.validate import (  # noqa: F401
     kubectl_validate,
-    validate,
     validate_or_raise,
 )
+from k8s_distributed_deeplearning_tpu.launch import validate  # noqa: F401,E402
 from k8s_distributed_deeplearning_tpu.launch.local_executor import (  # noqa: F401
     WorkerResult,
     run_local,
