@@ -81,6 +81,19 @@ def _time_training_steps(step, state, batch, rng, n_items: int, steps: int,
     return sorted(runs)[len(runs) // 2]
 
 
+def _llama_small_cfg(max_seq_len: int, **overrides):
+    """The 124M Llama-small bench model (train_llama.py "small" preset) —
+    single source of truth so the train and decode suites describe the
+    same architecture."""
+    import jax.numpy as jnp
+    from k8s_distributed_deeplearning_tpu.models import llama
+    base = dict(vocab_size=32000, dim=768, n_layers=12, n_heads=12,
+                n_kv_heads=4, mlp_dim=2048, max_seq_len=max_seq_len,
+                dtype=jnp.bfloat16)
+    base.update(overrides)
+    return llama.config_tiny(**base)
+
+
 def measure_llama(steps: int, warmup: int, batch: int = 8,
                   seq_len: int = 2048, repeats: int = 3) -> dict:
     """Tokens/sec/chip + measured MFU of the full sharded train step on a
@@ -97,10 +110,7 @@ def measure_llama(steps: int, warmup: int, batch: int = 8,
     from k8s_distributed_deeplearning_tpu.parallel import sharding
 
     mesh = mesh_lib.make_mesh({"data": -1})
-    cfg = llama.config_tiny(vocab_size=32000, dim=768, n_layers=12,
-                            n_heads=12, n_kv_heads=4, mlp_dim=2048,
-                            max_seq_len=seq_len, dtype=jnp.bfloat16,
-                            attention_impl="flash")
+    cfg = _llama_small_cfg(seq_len, attention_impl="flash")
     model = llama.LlamaLM(cfg)
 
     def loss(params, b, rng):
@@ -219,6 +229,42 @@ def measure_zoo(steps: int = 15, warmup: int = 3) -> dict:
     return out
 
 
+def measure_decode(batch: int = 8, prompt_len: int = 128,
+                   new_tokens: int = 128, repeats: int = 3) -> dict:
+    """Autoregressive decode tokens/sec on the Llama-small config through
+    generate() (windowed KV cache + jitted scan loop); the number behind
+    BENCHMARKS.md's decode table."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from k8s_distributed_deeplearning_tpu.models import generate as gen
+    from k8s_distributed_deeplearning_tpu.models import llama
+
+    cfg = _llama_small_cfg(2048)
+    model = llama.LlamaLM(cfg)
+    params = model.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))[
+        "params"]
+    prompt = jax.random.randint(jax.random.key(1), (batch, prompt_len), 0,
+                                cfg.vocab_size, dtype=jnp.int32)
+
+    def run():
+        return np.asarray(gen.generate(model, params, prompt,
+                                       max_new_tokens=new_tokens))
+
+    run()  # compile
+    runs = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        run()  # np.asarray = value fetch (honest sync)
+        runs.append(batch * new_tokens / (time.perf_counter() - t0))
+    tps = sorted(runs)[len(runs) // 2]
+    return {"decode_tokens_per_sec": round(tps, 1),
+            "decode_config": {"params_m": 124, "batch": batch,
+                              "prompt": prompt_len, "new": new_tokens,
+                              "kv_window": "auto (128-aligned)"}}
+
+
 def measure_attention(seq_lens=(1024, 2048, 4096), steps: int = 20,
                       warmup: int = 3) -> dict:
     """Flash (Pallas) vs XLA attention, fwd and fwd+bwd, causal, bf16,
@@ -286,7 +332,8 @@ def main() -> None:
     # 2048 -> ~300k img/s/chip, 16384 -> ~560k, flat beyond).
     ap.add_argument("--batch-size", type=int, default=16384)
     ap.add_argument("--suite",
-                    choices=["all", "mnist", "llama", "attention", "zoo"],
+                    choices=["all", "mnist", "llama", "attention", "zoo",
+                             "decode"],
                     default="all")
     ap.add_argument("--cpu-baseline", action="store_true",
                     help="internal: measure the CPU reference stand-in")
@@ -322,6 +369,15 @@ def main() -> None:
             "metric": "llama_small_tokens_per_sec_per_chip",
             "value": extra["llama_small_tokens_per_sec_per_chip"],
             "unit": "tokens/sec/chip",
+            "vs_baseline": None,
+            "extra": extra}))
+        return
+    if args.suite == "decode":
+        extra = measure_decode()
+        print(json.dumps({
+            "metric": "llama_small_decode_tokens_per_sec",
+            "value": extra["decode_tokens_per_sec"],
+            "unit": "tokens/sec",
             "vs_baseline": None,
             "extra": extra}))
         return
