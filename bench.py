@@ -62,10 +62,21 @@ def measure(batch_size: int, steps: int, warmup: int, dtype: str,
 def _time_training_steps(step, state, batch, rng, n_items: int, steps: int,
                          warmup: int, repeats: int = 3) -> float:
     """Median items/sec over *repeats* timing windows of a compiled train
-    step. One shared harness so the honest-sync discipline can't drift:
-    warmup first, then each window ends on a VALUE fetch (``float(loss)``) —
-    on relayed/remote backends ``block_until_ready`` can return before
+    step (spread available via :func:`_time_training_steps_spread`). One
+    shared harness so the honest-sync discipline can't drift: warmup first,
+    then each window ends on a VALUE fetch (``float(loss)``) — on
+    relayed/remote backends ``block_until_ready`` can return before
     execution truly finishes, which would flatter the number."""
+    return _time_training_steps_spread(step, state, batch, rng, n_items,
+                                       steps, warmup, repeats)[0]
+
+
+def _time_training_steps_spread(step, state, batch, rng, n_items: int,
+                                steps: int, warmup: int,
+                                repeats: int = 3) -> tuple[float, float]:
+    """(median items/sec, relative spread (max-min)/median) over *repeats*
+    timing windows — the spread quantifies run-to-run noise so the
+    regression gate's band is evidence-based, not a guess."""
     for _ in range(warmup):
         state, loss, _ = step(state, batch, rng)
     float(loss)
@@ -78,18 +89,25 @@ def _time_training_steps(step, state, batch, rng, n_items: int, steps: int,
         dt = time.perf_counter() - t0
         assert final == final, "NaN loss in benchmark"
         runs.append(n_items * steps / dt)
-    return sorted(runs)[len(runs) // 2]
+    med = sorted(runs)[len(runs) // 2]
+    return med, (max(runs) - min(runs)) / med
 
 
 def _llama_small_cfg(max_seq_len: int, **overrides):
     """The 124M Llama-small bench model (train_llama.py "small" preset) —
     single source of truth so the train and decode suites describe the
-    same architecture."""
+    same architecture.
+
+    Training-path defaults come from the round-3 measured sweep at S=2048
+    (BENCHMARKS.md): unrolled layers (scan stacking of remat residuals via
+    dynamic-update-slice cost ~14% of the step) + remat 'dots' (faster than
+    both no-remat and 'nothing' — the backward is residual-traffic-bound)."""
     import jax.numpy as jnp
     from k8s_distributed_deeplearning_tpu.models import llama
     base = dict(vocab_size=32000, dim=768, n_layers=12, n_heads=12,
                 n_kv_heads=4, mlp_dim=2048, max_seq_len=max_seq_len,
-                dtype=jnp.bfloat16)
+                dtype=jnp.bfloat16, remat=True, remat_policy="dots",
+                scan_layers=False)
     base.update(overrides)
     return llama.config_tiny(**base)
 
@@ -124,17 +142,20 @@ def measure_llama(steps: int, warmup: int, batch: int = 8,
     toks = jax.random.randint(jax.random.key(1), (batch, seq_len + 1), 0,
                               cfg.vocab_size, dtype=jnp.int32)
     b = tr.shard_batch({"tokens": toks})
-    tps = _time_training_steps(step, state, b, jax.random.key(2),
-                               batch * seq_len, steps, warmup, repeats)
+    tps, spread = _time_training_steps_spread(
+        step, state, b, jax.random.key(2), batch * seq_len, steps, warmup,
+        repeats)
     n_chips = jax.device_count()
     peak = mesh_lib.peak_flops_per_device("bfloat16")
-    mfu = tps / n_chips * llama.flops_per_token(cfg) / peak
+    mfu = tps / n_chips * llama.flops_per_token(cfg, seq_len=seq_len) / peak
     return {
         "llama_small_tokens_per_sec_per_chip": round(tps / n_chips, 1),
         "llama_small_mfu": round(mfu, 4),
+        "llama_small_spread_pct": round(100 * spread, 2),
         "llama_small_config": {"params_m": 124, "seq_len": seq_len,
                                "batch": batch, "dtype": "bfloat16",
-                               "attention": "flash"},
+                               "attention": "flash",
+                               "remat": "dots, unrolled layers"},
     }
 
 
@@ -147,8 +168,8 @@ def measure_zoo(steps: int = 15, warmup: int = 3) -> dict:
     import jax.numpy as jnp
     import optax
 
-    from k8s_distributed_deeplearning_tpu.models import (bert, llama, resnet,
-                                                         vit)
+    from k8s_distributed_deeplearning_tpu.models import (bert, resnet,
+                                                         transformer, vit)
     from k8s_distributed_deeplearning_tpu.parallel import mesh as mesh_lib
     from k8s_distributed_deeplearning_tpu.parallel import sharding
 
@@ -163,8 +184,10 @@ def measure_zoo(steps: int = 15, warmup: int = 3) -> dict:
 
     # --- BERT-base MLM, S=512 ------------------------------------------
     # remat: without it the 12 layers' [B,H,S,S] f32 score matrices + the
-    # [B,S,30522] MLM logits exceed one v5e's 16G HBM at B=16.
-    cfg = bert.config_bert_base(dtype=jnp.bfloat16, remat=True)
+    # [B,S,30522] MLM logits exceed one v5e's 16G HBM at B=16. Unrolled
+    # layers for the same measured reason as the llama config.
+    cfg = bert.config_bert_base(dtype=jnp.bfloat16, remat=True,
+                                scan_layers=False)
     model = bert.BertMLM(cfg)
     B, S = 16, 512
     tr = sharding.ShardedTrainer(
@@ -179,12 +202,14 @@ def measure_zoo(steps: int = 15, warmup: int = 3) -> dict:
                             "weights": weights})
     tps = time_steps(tr.make_step(donate=True), state, batch,
                      jax.random.key(3), B * S)
-    mfu = tps / n_chips * llama.flops_per_token(cfg) / peak
+    # Per-architecture FLOPs (GELU => 2 MLP matmuls), actual S.
+    mfu = tps / n_chips * transformer.flops_per_token(cfg, seq_len=S) / peak
     out["bert_base_tokens_per_sec_per_chip"] = round(tps / n_chips, 1)
     out["bert_base_mfu"] = round(mfu, 4)
 
     # --- ViT-L/16, 224x224 ---------------------------------------------
-    cfg = vit.config_vit_l16(dtype=jnp.bfloat16, remat=True)
+    cfg = vit.config_vit_l16(dtype=jnp.bfloat16, remat=True,
+                             scan_layers=False)
     model = vit.ViT(cfg)
     B = 32
     tr = sharding.ShardedTrainer(
@@ -197,8 +222,7 @@ def measure_zoo(steps: int = 15, warmup: int = 3) -> dict:
         "label": jax.random.randint(jax.random.key(2), (B,), 0, 1000)})
     ips = time_steps(tr.make_step(donate=True), state, batch,
                      jax.random.key(3), B)
-    # ViT FLOPs/image ~ transformer flops over 197 tokens.
-    mfu = ips / n_chips * llama.flops_per_token(cfg) * 197 / peak
+    mfu = ips / n_chips * vit.flops_per_image(cfg, image_size=224) / peak
     out["vit_l16_images_per_sec_per_chip"] = round(ips / n_chips, 1)
     out["vit_l16_mfu"] = round(mfu, 4)
 
@@ -241,7 +265,10 @@ def measure_decode(batch: int = 8, prompt_len: int = 128,
     from k8s_distributed_deeplearning_tpu.models import generate as gen
     from k8s_distributed_deeplearning_tpu.models import llama
 
-    cfg = _llama_small_cfg(2048)
+    # Decode pins the config the published decode table was measured with:
+    # scanned layers (decode compiles one block body; unrolling only grows
+    # compile time) and no remat (no backward pass).
+    cfg = _llama_small_cfg(2048, scan_layers=True, remat=False)
     model = llama.LlamaLM(cfg)
     params = model.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))[
         "params"]
@@ -324,6 +351,49 @@ def measure_attention(seq_lens=(1024, 2048, 4096), steps: int = 20,
     return results
 
 
+BASELINE_FILE = os.path.join(REPO, "BENCH_BASELINE.json")
+
+
+def check_regression(record: dict) -> list[str]:
+    """Stored-baseline regression gate (VERDICT r2 item 1): compare the
+    record's headline numbers against BENCH_BASELINE.json; a metric below
+    baseline*(1 - band) is a regression. The band per metric is set from
+    measured window spread (~1% on the llama trainer; wider for the noisier
+    dispatch-bound suites), so a real 2-3% slide fails instead of shipping
+    silently."""
+    try:
+        with open(BASELINE_FILE) as f:
+            base = json.load(f)
+    except (FileNotFoundError, json.JSONDecodeError):
+        return []
+    flat = {record.get("metric"): record.get("value"),
+            **(record.get("extra") or {})}
+    msgs = []
+    for key, spec in base.items():
+        val = flat.get(key)
+        if not isinstance(val, (int, float)) or not isinstance(spec, dict):
+            continue
+        band = spec.get("band_pct", 3.0)
+        floor = spec["value"] * (1 - band / 100.0)
+        if val < floor:
+            msgs.append(
+                f"REGRESSION {key}: measured {val} < floor {round(floor, 1)}"
+                f" (baseline {spec['value']} − {band}% noise band)")
+    return msgs
+
+
+def emit(record: dict) -> None:
+    """Print the one-line JSON result, then apply the regression gate:
+    regressions go to stderr and exit nonzero (the metric line is already
+    out, so the driver still records it)."""
+    print(json.dumps(record))
+    msgs = check_regression(record)
+    if msgs:
+        for m in msgs:
+            print(m, file=sys.stderr)
+        sys.exit(2)
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--steps", type=int, default=30)
@@ -358,37 +428,37 @@ def main() -> None:
     n_chips = jax.device_count()
 
     if args.suite == "attention":
-        print(json.dumps({"metric": "attention_flash_vs_xla",
-                          "unit": "ms/call",
-                          "value": None, "vs_baseline": None,
-                          "extra": measure_attention(steps=args.steps)}))
+        emit({"metric": "attention_flash_vs_xla",
+              "unit": "ms/call",
+              "value": None, "vs_baseline": None,
+              "extra": measure_attention(steps=args.steps)})
         return
     if args.suite == "llama":
         extra = measure_llama(args.steps, args.warmup)
-        print(json.dumps({
+        emit({
             "metric": "llama_small_tokens_per_sec_per_chip",
             "value": extra["llama_small_tokens_per_sec_per_chip"],
             "unit": "tokens/sec/chip",
             "vs_baseline": None,
-            "extra": extra}))
+            "extra": extra})
         return
     if args.suite == "decode":
         extra = measure_decode()
-        print(json.dumps({
+        emit({
             "metric": "llama_small_decode_tokens_per_sec",
             "value": extra["decode_tokens_per_sec"],
             "unit": "tokens/sec",
             "vs_baseline": None,
-            "extra": extra}))
+            "extra": extra})
         return
     if args.suite == "zoo":
         extra = measure_zoo(steps=max(5, args.steps // 2))
-        print(json.dumps({
+        emit({
             "metric": "zoo_single_chip",
             "value": extra["bert_base_tokens_per_sec_per_chip"],
             "unit": "tokens/sec/chip (bert-base)",
             "vs_baseline": None,
-            "extra": extra}))
+            "extra": extra})
         return
 
     # Median of 3 timing windows over one compiled step: remote-tunnel
@@ -420,13 +490,13 @@ def main() -> None:
     except Exception:
         baseline = None
 
-    print(json.dumps({
+    emit({
         "metric": "mnist_conv_dp_images_per_sec_per_chip",
         "value": round(per_chip, 1),
         "unit": "images/sec/chip",
         "vs_baseline": round(per_chip / baseline, 2) if baseline else None,
         **({"extra": extra} if extra else {}),
-    }))
+    })
 
 
 if __name__ == "__main__":

@@ -53,8 +53,11 @@ PRESETS = {
     # name: overrides on llama.config_tiny / config_llama3_8b
     "tiny": dict(vocab_size=256, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
                  mlp_dim=128, max_seq_len=512),
+    # small: remat 'dots' + unrolled layers measured fastest at S=2048
+    # (BENCHMARKS.md round 3: 108.8k tok/s/chip vs 85.2k scanned/no-remat).
     "small": dict(vocab_size=32000, dim=768, n_layers=12, n_heads=12,
-                  n_kv_heads=4, mlp_dim=2048, max_seq_len=2048),
+                  n_kv_heads=4, mlp_dim=2048, max_seq_len=2048, remat=True,
+                  scan_layers=False),
     "1b": dict(vocab_size=32000, dim=2048, n_layers=16, n_heads=32,
                n_kv_heads=8, mlp_dim=8192, max_seq_len=4096, remat=True),
     "8b": dict(),          # the true Llama-3 8B architecture numbers
@@ -269,7 +272,8 @@ def main(argv: list[str] | None = None) -> dict:
         return prefetch.maybe(batcher.iter_from(start_step),
                               trainer.shard_batch, args.prefetch, prefetchers)
 
-    flops_per_example = llama.flops_per_token(model_cfg) * seq_len
+    flops_per_example = llama.flops_per_token(model_cfg,
+                                              seq_len=seq_len) * seq_len
     try:
         state = loop.fit(
             step_fn, state, global_batches, num_steps,

@@ -144,15 +144,10 @@ def loss_fn(model: LlamaLM, params, batch, rng=None, *,
     return loss, {"accuracy": acc, "perplexity": jnp.exp(loss)}
 
 
-def flops_per_token(cfg: TransformerConfig) -> float:
-    """Approximate fwd+bwd FLOPs per token (6N + attention) for MFU."""
-    hd = cfg.resolved_head_dim
-    per_layer = (
-        2 * cfg.dim * cfg.n_heads * hd          # q
-        + 2 * 2 * cfg.dim * cfg.resolved_kv_heads * hd  # k, v
-        + 2 * cfg.n_heads * hd * cfg.dim        # o
-        + 3 * 2 * cfg.dim * cfg.resolved_mlp_dim  # gate/up/down
-        + 2 * 2 * cfg.n_heads * hd * cfg.max_seq_len  # scores + pv (per token)
-    )
-    embed = 2 * cfg.dim * cfg.vocab_size
-    return 3.0 * (cfg.n_layers * per_layer + embed)
+def flops_per_token(cfg: TransformerConfig, *,
+                    seq_len: int | None = None) -> float:
+    """Approximate fwd+bwd FLOPs per token (6N + attention) for MFU — the
+    shared per-architecture accounting in :func:`models.transformer
+    .flops_per_token` (SwiGLU => 3 MLP matmuls here)."""
+    from k8s_distributed_deeplearning_tpu.models import transformer
+    return transformer.flops_per_token(cfg, seq_len=seq_len)

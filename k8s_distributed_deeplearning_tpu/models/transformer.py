@@ -28,6 +28,7 @@ from typing import Any, Callable
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 
 from k8s_distributed_deeplearning_tpu.ops import attention as attention_ops
 
@@ -37,11 +38,21 @@ embed_init = nn.initializers.normal(stddev=0.02)
 
 # Rematerialization policies (config knob `remat_policy`): "dots" keeps
 # matmul outputs through remat (skips recomputing the MXU work — measured
-# faster at long context, BENCHMARKS.md); "nothing" recomputes everything
-# (minimal memory). Shared by the scan/remat stack here and the pipeline
-# engine's per-layer checkpointing (parallel/pipeline_lm.py).
+# fastest at S=2048, BENCHMARKS.md round 3); "dots_attn" additionally saves
+# the flash-attention output (tagged `checkpoint_name` in Attention) — the
+# Pallas call is not a dot, so "dots" alone recomputes the whole attention
+# forward in the backward pass; saving it costs [B,S,D_model] bf16 per
+# layer and removes that recompute, but the extra residual traffic measured
+# slightly SLOWER than recomputing (105.9k vs 108.8k tok/s at S=2048) — it
+# exists for configs where attention recompute dominates (long S);
+# "nothing" recomputes everything (minimal memory). Shared by the
+# scan/remat stack here and the pipeline engine's per-layer checkpointing
+# (parallel/pipeline_lm.py).
 REMAT_POLICIES = {
     "dots": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+    "dots_attn": jax.checkpoint_policies.save_from_both_policies(
+        jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+        jax.checkpoint_policies.save_only_these_names("attn_out")),
     "nothing": jax.checkpoint_policies.nothing_saveable,
 }
 
@@ -275,6 +286,10 @@ class Attention(nn.Module):
                 out = attention_ops.multi_head_attention(
                     q, k, v, causal=cfg.causal, mask=mask,
                     segment_ids=segment_ids, impl=cfg.attention_impl)
+            # Tag for the "dots_attn" remat policy: lets jax.checkpoint save
+            # exactly this tensor so the backward pass skips re-running the
+            # attention forward (a no-op under other policies).
+            out = checkpoint_name(out, "attn_out")
         out = nn.with_logical_constraint(out, ("batch", "seq", "heads", "head_dim"))
         out = nn.DenseGeneral(cfg.dim, axis=(-2, -1), use_bias=False,
                               dtype=cfg.dtype, param_dtype=jnp.float32,
@@ -423,6 +438,29 @@ class Transformer(nn.Module):
                     deterministic=deterministic, attention_fn=attention_fn,
                     **dkw)
         return make_norm(cfg, "final_norm")(x)
+
+
+def flops_per_token(cfg: TransformerConfig, *, seq_len: int | None = None,
+                    include_vocab: bool = True) -> float:
+    """Approximate fwd+bwd FLOPs per token for MFU accounting (6N + attention
+    convention): QKV/O projections, the MLP matmuls — 3 for SwiGLU, 2 for
+    GELU (reusing the SwiGLU count for GELU models overstated BERT/ViT MFU
+    ~20%) — the S^2 attention score+PV term at the *actual* sequence length,
+    and the embedding/unembedding matmul when the model has a vocab head.
+    Causal kernels do ~half the S^2 work; the full-S^2 convention is kept
+    (PaLM-style), so causal MFU is conservative."""
+    hd = cfg.resolved_head_dim
+    s = seq_len or cfg.max_seq_len
+    n_mlp_matmuls = 3 if cfg.activation == "swiglu" else 2
+    per_layer = (
+        2 * cfg.dim * cfg.n_heads * hd                    # q proj
+        + 2 * 2 * cfg.dim * cfg.resolved_kv_heads * hd    # k, v proj
+        + 2 * cfg.n_heads * hd * cfg.dim                  # o proj
+        + n_mlp_matmuls * 2 * cfg.dim * cfg.resolved_mlp_dim
+        + 2 * 2 * cfg.n_heads * hd * s                    # scores + PV
+    )
+    vocab = 2 * cfg.dim * cfg.vocab_size if include_vocab else 0
+    return 3.0 * (cfg.n_layers * per_layer + vocab)
 
 
 class LMHead(nn.Module):

@@ -53,9 +53,16 @@ def _pick_block(s: int, target: int) -> int:
     return b
 
 
-def _block_sizes(sq: int, sk: int, target: int = 512) -> tuple[int, int]:
-    """Largest power-of-two block sizes ≤ target dividing the seq lengths."""
-    return _pick_block(sq, target), _pick_block(sk, target)
+def _block_sizes(sq: int, sk: int) -> tuple[int, int]:
+    """Largest power-of-two block sizes ≤ the swept targets dividing the seq
+    lengths. 512/512 won the v5e sweep at S=2048-8192 (BENCHMARKS.md "flash
+    block sweep"); the knobs exist so future sweeps don't edit the kernel."""
+    return _pick_block(sq, _BLOCK_Q), _pick_block(sk, _BLOCK_K)
+
+
+# Fine-block size targets (power-of-two caps; clipped to divide S).
+_BLOCK_Q = 512
+_BLOCK_K = 512
 
 
 # K/V (and in the dK/dV pass, Q/dO) ride into VMEM in SUPERBLOCKS of this
@@ -95,7 +102,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest,
     base = kb * sb                       # first K column of this superblock
     resident = n_sb == 1                 # static: whole Sk fits one step
     last_row = qi * block_q + block_q - 1 + off
-    q = q_ref[0].astype(jnp.float32) * scale                      # [bq, d]
+    # Matmul inputs stay in the storage dtype (bf16 rides the MXU's native
+    # path; f32 inputs would run the systolic array below peak) with f32
+    # accumulation via preferred_element_type; the softmax scale applies to
+    # the f32 scores.
+    q = q_ref[0]                                                  # [bq, d]
 
     def n_inner():
         if causal:
@@ -108,10 +119,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest,
 
     def body(j, carry):
         m, l, acc = carry
-        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        k = k_ref[0, pl.ds(j * block_k, block_k), :]
+        v = v_ref[0, pl.ds(j * block_k, block_k), :]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
+                                preferred_element_type=jnp.float32) * scale
         if causal:
             row = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, s.shape, 0)
@@ -125,11 +136,18 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest,
         bm = jnp.max(s, axis=-1)
         m_new = jnp.maximum(m, bm)
         p = jnp.exp(s - m_new[:, None])
-        p = jnp.where(s <= NEG_INF / 2, 0.0, p)
+        if segments:
+            # A fully-masked row (possible only under segment masks — every
+            # causal row sees at least column 0) has m == NEG_INF and would
+            # exp(0) = 1; zero it. Pure-causal rows masked to NEG_INF
+            # underflow to exactly 0 on their own, saving the pass.
+            p = jnp.where(s <= NEG_INF / 2, 0.0, p)
         alpha = jnp.exp(m - m_new)
         l_new = alpha * l + jnp.sum(p, axis=-1)
+        # P rides the MXU in the storage dtype too — the same trade the XLA
+        # path makes (probs.astype(v.dtype) before the PV matmul).
         acc_new = alpha[:, None] * acc + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         return m_new, l_new, acc_new
 
@@ -265,8 +283,10 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
     base = kb * sb
     resident = n_sb == 1
     last_row = qi * block_q + block_q - 1 + off
-    q = q_ref[0].astype(jnp.float32) * scale
-    do = do_ref[0].astype(jnp.float32)
+    # bf16 matmul inputs / f32 accumulation (see _fwd_kernel); the softmax
+    # scale folds into ds once instead of pre-scaling q and post-scaling dq.
+    q = q_ref[0]
+    do = do_ref[0]
     lse = lse_ref[0, 0]
     delta = delta_ref[0, 0]
 
@@ -277,10 +297,10 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
         return sb // block_k
 
     def body(j, dq):
-        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        k = k_ref[0, pl.ds(j * block_k, block_k), :]
+        v = v_ref[0, pl.ds(j * block_k, block_k), :]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
+                                preferred_element_type=jnp.float32) * scale
         if causal:
             row = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, s.shape, 0)
@@ -292,17 +312,20 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
             sk_ids = segk_ref[0, 0, pl.ds(j * block_k, block_k)]
             s = jnp.where(sq_ids[:, None] == sk_ids[None, :], s, NEG_INF)
         p = jnp.exp(s - lse[:, None])
-        p = jnp.where(s <= NEG_INF / 2, 0.0, p)
+        if segments:
+            # Fully-masked rows (segment masks only — see _fwd_kernel) have
+            # a degenerate lse; force their probabilities to exact zero.
+            p = jnp.where(s <= NEG_INF / 2, 0.0, p)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None])
+        ds = (p * (dp - delta[:, None]) * scale).astype(k.dtype)
         return dq + jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
                                         preferred_element_type=jnp.float32)
 
     if resident:
         dq = jax.lax.fori_loop(0, n_inner(), body,
-                               jnp.zeros_like(q))
-        dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+                               jnp.zeros((block_q, q.shape[-1]), jnp.float32))
+        dq_ref[0] = dq.astype(dq_ref.dtype)
         return
 
     @pl.when(kb == 0)
@@ -317,7 +340,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
 
     @pl.when(kb == n_sb - 1)
     def _emit():
-        dq_ref[0] = (dq_s[...] * scale).astype(dq_ref.dtype)
+        dq_ref[0] = dq_s[...].astype(dq_ref.dtype)
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
@@ -336,8 +359,10 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
     base = qb * sb                     # first Q row of this superblock
     resident = n_sb == 1
     first_col = ki * block_k
-    k = k_ref[0].astype(jnp.float32)
-    v = v_ref[0].astype(jnp.float32)
+    # bf16 matmul inputs / f32 accumulation; scale folds into ds (see
+    # _bwd_dq_kernel).
+    k = k_ref[0]
+    v = v_ref[0]
 
     def first_inner():
         if causal:
@@ -349,13 +374,12 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
 
     def body(i, carry):
         dk, dv = carry
-        q = q_ref[0, pl.ds(i * block_q, block_q), :].astype(
-            jnp.float32) * scale
-        do = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        q = q_ref[0, pl.ds(i * block_q, block_q), :]
+        do = do_ref[0, pl.ds(i * block_q, block_q), :]
         lse = lse_ref[0, 0, pl.ds(i * block_q, block_q)]
         delta = delta_ref[0, 0, pl.ds(i * block_q, block_q)]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
+                                preferred_element_type=jnp.float32) * scale
         if causal:
             row = base + i * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, s.shape, 0)
@@ -367,19 +391,24 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
             sk_ids = segk_ref[0, 0]
             s = jnp.where(sq_ids[:, None] == sk_ids[None, :], s, NEG_INF)
         p = jnp.exp(s - lse[:, None])
-        p = jnp.where(s <= NEG_INF / 2, 0.0, p)
-        dv = dv + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+        if segments:
+            # Fully-masked rows (segment masks only — see _fwd_kernel) have
+            # a degenerate lse; force their probabilities to exact zero.
+            p = jnp.where(s <= NEG_INF / 2, 0.0, p)
+        dv = dv + jax.lax.dot_general(p.astype(do.dtype), do,
+                                      (((0,), (0,)), ((), ())),
                                       preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None])
+        ds = (p * (dp - delta[:, None]) * scale).astype(q.dtype)
         dk = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
                                       preferred_element_type=jnp.float32)
         return dk, dv
 
     if resident:
+        zero = lambda a: jnp.zeros(a.shape, jnp.float32)
         dk, dv = jax.lax.fori_loop(first_inner(), sb // block_q, body,
-                                   (jnp.zeros_like(k), jnp.zeros_like(v)))
+                                   (zero(k), zero(v)))
         dk_ref[0] = dk.astype(dk_ref.dtype)
         dv_ref[0] = dv.astype(dv_ref.dtype)
         return
