@@ -222,7 +222,7 @@ def measure_zoo(steps: int = 15, warmup: int = 3) -> dict:
         "label": jax.random.randint(jax.random.key(2), (B,), 0, 1000)})
     ips = time_steps(tr.make_step(donate=True), state, batch,
                      jax.random.key(3), B)
-    mfu = ips / n_chips * vit.flops_per_image(cfg, image_size=224) / peak
+    mfu = ips / n_chips * vit.flops_per_image(model, image_size=224) / peak
     out["vit_l16_images_per_sec_per_chip"] = round(ips / n_chips, 1)
     out["vit_l16_mfu"] = round(mfu, 4)
 
@@ -469,7 +469,10 @@ def main() -> None:
     extra: dict = {}
     if args.suite == "all":
         try:
-            extra = measure_llama(max(10, args.steps // 3), 3)
+            # Same window length as --suite llama: the regression gate's
+            # noise band was calibrated on 30-step windows — a shorter,
+            # noisier window here would trip false regressions.
+            extra = measure_llama(args.steps, args.warmup)
         except Exception as e:  # never lose the primary metric to a crash
             extra = {"llama_bench_error": repr(e)}
 

@@ -55,6 +55,9 @@ PRESETS = {
                  mlp_dim=128, max_seq_len=512),
     # small: remat 'dots' + unrolled layers measured fastest at S=2048
     # (BENCHMARKS.md round 3: 108.8k tok/s/chip vs 85.2k scanned/no-remat).
+    # Unrolling changes the checkpoint tree (block_0..block_11 instead of
+    # the scanned blocks/[L,...]) — resume pre-round-3 runs with
+    # --scan-layers, and --pp forces the scanned layout back on.
     "small": dict(vocab_size=32000, dim=768, n_layers=12, n_heads=12,
                   n_kv_heads=4, mlp_dim=2048, max_seq_len=2048, remat=True,
                   scan_layers=False),
@@ -76,6 +79,11 @@ def build_config(args) -> "llama.TransformerConfig":
     overrides["dtype"] = (jnp.bfloat16 if args.dtype == "bfloat16"
                           else jnp.float32)
     overrides["remat"] = args.remat or overrides.get("remat", False)
+    if getattr(args, "scan_layers", None) is not None:
+        overrides["scan_layers"] = args.scan_layers
+    if getattr(args, "pp", 1) > 1:
+        # The pipeline engine slices the scan-stacked [L, ...] layout.
+        overrides["scan_layers"] = True
     if args.attention in ("flash", "xla"):
         overrides["attention_impl"] = args.attention
     return base(**overrides)
@@ -104,6 +112,17 @@ def main(argv: list[str] | None = None) -> dict:
                         "at S>=1024, XLA otherwise (BENCHMARKS.md)")
     parser.add_argument("--remat", action="store_true",
                         help="checkpoint each block (long-context memory lever)")
+    parser.add_argument("--scan-layers", dest="scan_layers",
+                        action="store_true", default=None,
+                        help="stack layers via nn.scan (params under "
+                        "blocks/[L,...]); default: preset's choice. NOTE: "
+                        "scanned and unrolled layouts have different "
+                        "checkpoint trees — keep the setting a run started "
+                        "with when resuming")
+    parser.add_argument("--no-scan-layers", dest="scan_layers",
+                        action="store_false",
+                        help="unroll layers (block_0..block_{L-1} params; "
+                        "measured faster at S=2048, BENCHMARKS.md)")
     parser.add_argument("--data-path", type=str, default=None,
                         help="byte-level corpus file; default synthetic tokens")
     parser.add_argument("--pack", action="store_true",

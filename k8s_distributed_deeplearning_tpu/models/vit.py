@@ -33,17 +33,18 @@ def config_tiny(**overrides) -> TransformerConfig:
     return TransformerConfig(**base)
 
 
-def flops_per_image(cfg: TransformerConfig, *, image_size: int = 224,
-                    patch_size: int = 16, num_classes: int = 1000) -> float:
+def flops_per_image(model: "ViT", *, image_size: int = 224) -> float:
     """Approximate fwd+bwd FLOPs per image for MFU: encoder FLOPs at the
-    image's actual token count ((H/p)^2 + [CLS]) — not a hard-coded 197 —
-    plus the patch-embed conv and the classification head."""
+    image's actual token count ((H/p)^2 + [CLS]) — patch size and class
+    count come from the model instance, not hard-coded — plus the
+    patch-embed conv and the classification head."""
     from k8s_distributed_deeplearning_tpu.models import transformer
-    tokens = (image_size // patch_size) ** 2 + 1
+    cfg = model.cfg
+    tokens = (image_size // model.patch_size) ** 2 + 1
     encoder = transformer.flops_per_token(
         cfg, seq_len=tokens, include_vocab=False) * tokens
-    patch = 3.0 * 2 * (patch_size ** 2 * 3) * cfg.dim * (tokens - 1)
-    head = 3.0 * 2 * cfg.dim * num_classes
+    patch = 3.0 * 2 * (model.patch_size ** 2 * 3) * cfg.dim * (tokens - 1)
+    head = 3.0 * 2 * cfg.dim * model.num_classes
     return encoder + patch + head
 
 

@@ -136,11 +136,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest,
         bm = jnp.max(s, axis=-1)
         m_new = jnp.maximum(m, bm)
         p = jnp.exp(s - m_new[:, None])
-        if segments:
-            # A fully-masked row (possible only under segment masks — every
-            # causal row sees at least column 0) has m == NEG_INF and would
-            # exp(0) = 1; zero it. Pure-causal rows masked to NEG_INF
-            # underflow to exactly 0 on their own, saving the pass.
+        if segments or off < 0:
+            # A fully-masked row has m == NEG_INF and would exp(0) = 1;
+            # zero it. Possible under segment masks, and under causal with
+            # sq > sk (off < 0: leading rows see no columns). In the common
+            # causal sk >= sq case every row sees at least column 0, so
+            # masked entries underflow to exactly 0 on their own — skip the
+            # pass.
             p = jnp.where(s <= NEG_INF / 2, 0.0, p)
         alpha = jnp.exp(m - m_new)
         l_new = alpha * l + jnp.sum(p, axis=-1)
@@ -312,9 +314,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
             sk_ids = segk_ref[0, 0, pl.ds(j * block_k, block_k)]
             s = jnp.where(sq_ids[:, None] == sk_ids[None, :], s, NEG_INF)
         p = jnp.exp(s - lse[:, None])
-        if segments:
-            # Fully-masked rows (segment masks only — see _fwd_kernel) have
-            # a degenerate lse; force their probabilities to exact zero.
+        if segments or off < 0:
+            # Fully-masked rows (segment masks, or causal sq > sk — see
+            # _fwd_kernel) have a degenerate lse; force exact zeros.
             p = jnp.where(s <= NEG_INF / 2, 0.0, p)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
@@ -391,9 +393,9 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
             sk_ids = segk_ref[0, 0]
             s = jnp.where(sq_ids[:, None] == sk_ids[None, :], s, NEG_INF)
         p = jnp.exp(s - lse[:, None])
-        if segments:
-            # Fully-masked rows (segment masks only — see _fwd_kernel) have
-            # a degenerate lse; force their probabilities to exact zero.
+        if segments or off < 0:
+            # Fully-masked rows (segment masks, or causal sq > sk — see
+            # _fwd_kernel) have a degenerate lse; force exact zeros.
             p = jnp.where(s <= NEG_INF / 2, 0.0, p)
         dv = dv + jax.lax.dot_general(p.astype(do.dtype), do,
                                       (((0,), (0,)), ((), ())),

@@ -12,9 +12,13 @@ SPMD collectives to be called **inside** ``shard_map`` (or wrapped via
   around the ring via ``lax.ppermute`` while each device accumulates its
   queries' attention with an online softmax (running max ``m``, normalizer
   ``l``, unnormalized accumulator ``o`` — flash-attention statistics). Peak
-  memory per device is O(S_local²) scores, never the global S² matrix, and
-  the N-1 rotations ride ICI neighbor links. The rotation schedule unrolls at
-  trace time so XLA overlaps each ppermute with the previous block's compute.
+  memory per device is O(S_local²) scores, never the global S² matrix — in
+  the backward too: a custom VJP re-rotates K/V and recomputes each P block
+  from (q, k, lse), and both rotation loops are ``lax.scan`` so score-block
+  buffers are reused across steps by construction (asserted flat in ring
+  length by ``memory_analysis`` in tests). The rotations ride ICI neighbor
+  links; within one scan step the ppermute has no data dependence on the
+  block attend, so XLA's async collectives overlap rotation with compute.
 - **Ulysses** (all-to-all): transpose seq-sharding into head-sharding with
   ``lax.all_to_all``, run ordinary (local, e.g. flash) attention over the full
   sequence per head group, transpose back. Cheaper at moderate S (two
@@ -64,34 +68,31 @@ def _repeat_kv(x, n_rep):
     return x if n_rep == 1 else jnp.repeat(x, n_rep, axis=2)
 
 
-def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
-                   axis_name: str = "sequence", causal: bool = True,
-                   softmax_scale: float | None = None) -> jax.Array:
-    """Exact attention over a sequence-sharded QKV, inside ``shard_map``.
+def _ring_fwd_loop(q, k, v, axis_name, causal, scale):
+    """The forward rotation loop -> (out [B,Sq,H,D] in q.dtype,
+    lse [B,H,Sq] f32). lse = m + log(l) is the flash-attention
+    log-normalizer the backward uses to recompute every P block.
 
-    q/k/v: this device's sequence shard, [B, S_local, H(q|kv), D]. Output has
-    q's shape. Matches single-device attention bit-for-bit up to f32 softmax
-    reassociation (verified in tests against ``ops.attention``).
-    """
+    Written as ``lax.scan`` over the ring steps so per-step score blocks are
+    provably reused (unrolling let the scheduler keep ~2 [B,H,Sq,Sk]
+    transients live PER STEP — memory grew with ring length). XLA still
+    overlaps each rotation with that step's compute: the ppermute has no
+    data dependence on the block attend inside one iteration."""
     n = lax.axis_size(axis_name)
     r = lax.axis_index(axis_name)
     hq, hkv = q.shape[2], k.shape[2]
     # GQA: K/V rotate around the ring UNEXPANDED (hq/hkv x less ppermute
     # traffic on ICI); heads expand locally right before each block attend.
     g_rep = hq // hkv
-    scale = softmax_scale if softmax_scale is not None else q.shape[-1] ** -0.5
     sq, sk = q.shape[1], k.shape[1]
     b, h = q.shape[0], hq
-
-    o = jnp.zeros((b, sq, h, q.shape[-1]), jnp.float32)
-    l = jnp.zeros((b, h, sq), jnp.float32)
-    m = jnp.full((b, h, sq), NEG_INF, jnp.float32)
 
     row = lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
     col = lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
     shift_perm = [(i, (i - 1) % n) for i in range(n)]
 
-    for t in range(n):
+    def step(carry, t):
+        o, l, m, k, v = carry
         # Rotation sends shard i to i-1, so at step t we hold rank (r+t)%n's KV.
         src = (r + t) % n
         if causal:
@@ -107,13 +108,123 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
         l = alpha * l + beta * bl
         o = (alpha.transpose(0, 2, 1)[..., None] * o
              + beta.transpose(0, 2, 1)[..., None] * bo)
-        m = m_new
-        if t != n - 1:  # rotate KV to the next ring position
-            k = lax.ppermute(k, axis_name, shift_perm)
-            v = lax.ppermute(v, axis_name, shift_perm)
+        # Rotate KV to the next ring position (the final rotation brings
+        # them home — one redundant hop in exchange for a uniform body).
+        k = lax.ppermute(k, axis_name, shift_perm)
+        v = lax.ppermute(v, axis_name, shift_perm)
+        return (o, l, m_new, k, v), None
 
-    norm = jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
-    return (o / norm).astype(q.dtype)
+    o0 = jnp.zeros((b, sq, h, q.shape[-1]), jnp.float32)
+    l0 = jnp.zeros((b, h, sq), jnp.float32)
+    m0 = jnp.full((b, h, sq), NEG_INF, jnp.float32)
+    (o, l, m, _, _), _ = lax.scan(step, (o0, l0, m0, k, v), jnp.arange(n))
+
+    norm = jnp.maximum(l, 1e-30)
+    out = (o / norm.transpose(0, 2, 1)[..., None]).astype(q.dtype)
+    return out, m + jnp.log(norm)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _ring(q, k, v, axis_name, causal, scale):
+    return _ring_fwd_loop(q, k, v, axis_name, causal, scale)[0]
+
+
+def _ring_vjp_fwd(q, k, v, axis_name, causal, scale):
+    out, lse = _ring_fwd_loop(q, k, v, axis_name, causal, scale)
+    # Residuals are O(S_local): the local shards + (o, lse). Without this
+    # custom VJP, autodiff saves every ring step's [B,H,Sq,Sk] probability
+    # block — backward memory O(S_local x S_global), exactly what ring
+    # attention exists to avoid.
+    return out, (q, k, v, out, lse)
+
+
+def _ring_vjp_bwd(axis_name, causal, scale, res, do):
+    """Flash-structured ring backward: a second rotation pass. Each step
+    recomputes its P block from (q, k_t, lse), accumulates dq locally, and
+    accumulates dk/dv into buffers that TRAVEL WITH the K/V shards — after
+    n rotations the shards and their gradients arrive home together."""
+    q, k, v, out, lse = res
+    n = lax.axis_size(axis_name)
+    r = lax.axis_index(axis_name)
+    hq, hkv = q.shape[2], k.shape[2]
+    g_rep = hq // hkv
+    b, sq, _, d = q.shape
+    sk = k.shape[1]
+
+    dof = do.astype(jnp.float32)
+    # delta = rowsum(dO * O): the softmax-jacobian diagonal term, [B,H,Sq].
+    delta = jnp.sum(dof * out.astype(jnp.float32), axis=-1).transpose(0, 2, 1)
+
+    row = lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
+    col = lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+    shift_perm = [(i, (i - 1) % n) for i in range(n)]
+
+    def step(carry, t):
+        dq, dk, dv, k, v = carry
+        src = (r + t) % n
+        ke = _repeat_kv(k, g_rep)
+        ve = _repeat_kv(v, g_rep)
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, ke,
+                       preferred_element_type=jnp.float32) * scale
+        if causal:
+            s = jnp.where(((r * sq + row) >= (src * sk + col))[None, None],
+                          s, NEG_INF)
+        # exp(NEG_INF - lse) underflows to exact 0 (lse finite: causal rows
+        # always see their own diagonal position), so no extra zeroing pass.
+        p = jnp.exp(s - lse[..., None])
+        pc = p.astype(do.dtype)
+        dv_t = jnp.einsum("bhqk,bqhd->bkhd", pc, do,
+                          preferred_element_type=jnp.float32)
+        dp = jnp.einsum("bqhd,bkhd->bhqk", do, ve,
+                        preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta[..., None]) * scale).astype(q.dtype)
+        dq = dq + jnp.einsum("bhqk,bkhd->bqhd", ds, ke,
+                             preferred_element_type=jnp.float32)
+        dk_t = jnp.einsum("bhqk,bqhd->bkhd", ds, q,
+                          preferred_element_type=jnp.float32)
+        # Collapse expanded q-head groups back onto their KV head
+        # (_repeat_kv repeats each KV head g_rep times consecutively).
+        if g_rep != 1:
+            dk_t = dk_t.reshape(b, sk, hkv, g_rep, d).sum(axis=3)
+            dv_t = dv_t.reshape(b, sk, hkv, g_rep, d).sum(axis=3)
+        # dk/dv accumulators TRAVEL WITH the shard: after the n-th rotation
+        # each shard's gradient lands back on its owner.
+        dk = lax.ppermute(dk + dk_t, axis_name, shift_perm)
+        dv = lax.ppermute(dv + dv_t, axis_name, shift_perm)
+        k = lax.ppermute(k, axis_name, shift_perm)
+        v = lax.ppermute(v, axis_name, shift_perm)
+        return (dq, dk, dv, k, v), None
+
+    dq0 = jnp.zeros((b, sq, hq, d), jnp.float32)
+    dk0 = jnp.zeros((b, sk, hkv, d), jnp.float32)
+    dv0 = jnp.zeros((b, sk, hkv, d), jnp.float32)
+    (dq, dk, dv, _, _), _ = lax.scan(step, (dq0, dk0, dv0, k, v),
+                                     jnp.arange(n))
+
+    return (dq.astype(q.dtype), dk.astype(res[1].dtype),
+            dv.astype(res[2].dtype))
+
+
+_ring.defvjp(_ring_vjp_fwd, _ring_vjp_bwd)
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                   axis_name: str = "sequence", causal: bool = True,
+                   softmax_scale: float | None = None) -> jax.Array:
+    """Exact attention over a sequence-sharded QKV, inside ``shard_map``.
+
+    q/k/v: this device's sequence shard, [B, S_local, H(q|kv), D]. Output has
+    q's shape. Matches single-device attention bit-for-bit up to f32 softmax
+    reassociation (verified in tests against ``ops.attention``).
+
+    Differentiation goes through a custom VJP (``_ring_vjp_bwd``) that
+    re-rotates K/V and recomputes each P block from the saved (q, k, lse) —
+    the flash-attention trade — so backward residuals stay O(S_local) per
+    device instead of autodiff's O(S_local x S_global) saved score blocks
+    (asserted by a compiled ``memory_analysis`` test).
+    """
+    scale = softmax_scale if softmax_scale is not None else q.shape[-1] ** -0.5
+    return _ring(q, k, v, axis_name, causal, scale)
 
 
 def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,

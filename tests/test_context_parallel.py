@@ -116,3 +116,89 @@ def test_ulysses_with_flash_inner_matches_reference():
                                             inner_impl="flash")
     out = jax.jit(lambda q, k, v: fn(q, k, v, causal=True))(q, k, v)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_ring_attention_gqa_grads_match():
+    """The custom VJP's head-group collapse (dk/dv summed over expanded
+    q-head groups) must match reference GQA gradients."""
+    q, k, v = _qkv(s=16, hq=4, hkv=2)
+
+    def loss_ref(q, k, v):
+        return (attn_ops.dot_product_attention(q, k, v, causal=True)
+                ** 2).sum()
+
+    def loss_ring(q, k, v):
+        return (_run_sharded(cp.ring_attention, q, k, v, causal=True)
+                ** 2).sum()
+
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    g_ring = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_ref, g_ring):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a), atol=3e-5)
+
+
+def test_ring_backward_memory_flat_in_ring_steps():
+    """VERDICT r2 item 3: backward residuals must be O(S_local) per device,
+    not O(S_local x S_global). Compile the ring-attention gradient at fixed
+    per-device shard size on 2- and 4-device rings and assert per-device
+    temp memory does NOT scale with the ring length (plain autodiff saved
+    one [B,H,Sq,Sk] probability block per ring step, so its temp roughly
+    doubles from n=2 to n=4; the custom VJP recomputes P from (q, k, lse))."""
+    b, s_local, h, d = 1, 128, 4, 16
+
+    def temp_bytes(n):
+        import numpy as onp
+        from jax.sharding import Mesh
+        mesh = Mesh(onp.array(jax.devices()[:n]), ("sequence",))
+        spec = P(None, "sequence", None, None)
+        fn = jax.shard_map(
+            functools.partial(cp.ring_attention, causal=True),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=False)
+
+        def loss(q, k, v):
+            return fn(q, k, v).astype(jnp.float32).sum()
+
+        grad = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+        args = [jnp.zeros((b, s_local * n, h, d), jnp.float32)
+                for _ in range(3)]
+        return grad.lower(*args).compile().memory_analysis().temp_size_in_bytes
+
+    t2, t4 = temp_bytes(2), temp_bytes(4)
+    # Flat means the doubled ring adds only O(S_local) rotation buffers,
+    # not another 2x of saved score blocks.
+    assert t4 < 1.5 * t2, (t2, t4)
+
+
+@pytest.mark.slow
+def test_llama_long_context_trains_with_ring_attention():
+    """Long-S CP training on the virtual mesh: tiny Llama at S=1024 global
+    (256 per device over sequence=4), ring attention through the custom
+    VJP, finite decreasing loss."""
+    cfg = llama.config_tiny(dtype=jnp.float32, n_heads=4, n_kv_heads=2,
+                            max_seq_len=1024)
+    model = llama.LlamaLM(cfg)
+    tokens = jax.random.randint(jax.random.key(7), (2, 1025), 0,
+                                cfg.vocab_size)
+    mesh = mesh_lib.make_mesh({"data": 2, "sequence": 4})
+    ring_fn = cp.make_context_parallel_attention(mesh, "ring")
+
+    def loss(params, batch, rng):
+        toks = batch["tokens"]
+        inputs, targets = toks[:, :-1], toks[:, 1:]
+        logits = model.apply({"params": params}, inputs,
+                             attention_fn=ring_fn)
+        return (optax.softmax_cross_entropy_with_integer_labels(
+            logits, targets).mean(), {})
+
+    tr = sharding.ShardedTrainer(loss, optax.adam(1e-3), mesh)
+    state = tr.init(
+        lambda r: model.init(r, jnp.zeros((1, 8), jnp.int32))["params"],
+        jax.random.key(0))
+    step = tr.make_step(donate=False)
+    batch = tr.shard_batch({"tokens": tokens})
+    losses = []
+    for i in range(3):
+        state, l, _ = step(state, batch, jax.random.key(i))
+        losses.append(float(l))
+    assert all(np.isfinite(losses)) and losses[-1] < losses[0], losses
