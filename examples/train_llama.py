@@ -105,6 +105,13 @@ def main(argv: list[str] | None = None) -> dict:
                         "scan-stacked layers; composes with --dp only)")
     parser.add_argument("--pp-microbatches", type=int, default=None,
                         help="pipeline microbatches (default: --pp)")
+    parser.add_argument("--pp-schedule", choices=["gpipe", "1f1b"],
+                        default="gpipe",
+                        help="pipeline schedule: gpipe = lowest bubble "
+                        "(latency schedule); 1f1b = activation memory "
+                        "bounded at min(M, 2P) microbatches (memory "
+                        "schedule — measured 6.5x less temp at M=16, P=4, "
+                        "BENCHMARKS.md)")
     parser.add_argument("--attention",
                         choices=["auto", "xla", "flash", "ring", "ulysses"],
                         default="auto",
@@ -211,7 +218,7 @@ def main(argv: list[str] | None = None) -> dict:
         trainer = pipeline_lm.PipelineTrainer(
             model, optimizer, mesh,
             num_microbatches=args.pp_microbatches or args.pp,
-            chunked_ce=chunked)
+            chunked_ce=chunked, schedule=args.pp_schedule)
         loss = trainer.loss_fn
         state = trainer.init(init, jax.random.key(conf.seed))
         step_fn = trainer.make_step(donate=True)

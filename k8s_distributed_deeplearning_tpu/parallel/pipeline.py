@@ -37,22 +37,48 @@ PyTree = Any
 
 
 def _apply_local_stack(block_fn: Callable, stacked_params: PyTree,
-                       x: jax.Array) -> jax.Array:
-    """Run this stage's layers sequentially: scan over the local layer axis."""
-    def body(carry, layer_params):
-        return block_fn(layer_params, carry), None
-    out, _ = lax.scan(body, x, stacked_params)
+                       x: jax.Array, extras: PyTree = None,
+                       rng: jax.Array | None = None,
+                       layer_offset: jax.Array | int = 0) -> jax.Array:
+    """Run this stage's layers sequentially: scan over the local layer axis.
+
+    When *extras* (per-microbatch side inputs, e.g. segment ids/positions)
+    or *rng* are given, ``block_fn`` is called as
+    ``block_fn(layer_params, x, extras, rng_for_layer)`` with the rng folded
+    by GLOBAL layer index (*layer_offset* + local index) so dropout masks
+    differ per layer across stages; otherwise the plain two-argument form is
+    used (the test-suite's simple block functions stay valid)."""
+    n_local = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
+
+    def body(carry, xs):
+        layer_params, li = xs
+        if extras is None and rng is None:
+            return block_fn(layer_params, carry), None
+        lr = (None if rng is None
+              else jax.random.fold_in(rng, layer_offset + li))
+        return block_fn(layer_params, carry, extras, lr), None
+
+    out, _ = lax.scan(body, x, (stacked_params, jnp.arange(n_local)))
     return out
 
 
 def pipeline_apply(block_fn: Callable, stacked_params: PyTree, x: jax.Array, *,
                    num_microbatches: int,
-                   axis_name: str = "pipeline") -> jax.Array:
+                   axis_name: str = "pipeline",
+                   extras: PyTree = None,
+                   rng: jax.Array | None = None) -> jax.Array:
     """GPipe forward over a stage-sharded layer stack — call inside shard_map.
 
     ``block_fn(one_layer_params, x) -> x`` is a single layer; *stacked_params*
     leaves are [L_local, ...] (this stage's shard); *x* is this device's batch
     shard [B, ...] with B divisible by *num_microbatches*.
+
+    *extras* is an optional pytree of per-example side inputs (leaves
+    [B, ...], e.g. packed-sequence segment ids and positions): each stage
+    slices its current microbatch's extras locally — they ride no ppermute.
+    *rng* (optional) enables stochastic layers: every (microbatch, global
+    layer) pair gets an independent fold, and ``block_fn`` is then called as
+    ``block_fn(layer_params, x, extras, rng)``.
     """
     p = lax.axis_size(axis_name)
     stage = lax.axis_index(axis_name)
@@ -62,9 +88,25 @@ def pipeline_apply(block_fn: Callable, stacked_params: PyTree, x: jax.Array, *,
         raise ValueError(f"batch {b} not divisible by {m} microbatches")
     mb = b // m
     micro = x.reshape(m, mb, *x.shape[1:])
+    micro_extras = (None if extras is None else jax.tree.map(
+        lambda a: a.reshape(m, mb, *a.shape[1:]), extras))
+    n_local = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
+    layer_offset = stage * n_local
 
-    fwd = functools.partial(_apply_local_stack, block_fn, stacked_params)
-    out0 = jax.eval_shape(fwd, jax.ShapeDtypeStruct((mb, *x.shape[1:]), x.dtype))
+    def fwd(inp, ex, r):
+        return _apply_local_stack(block_fn, stacked_params, inp, ex, r,
+                                  layer_offset)
+
+    def slice_extras(i):
+        return (None if micro_extras is None else jax.tree.map(
+            lambda a: lax.dynamic_index_in_dim(a, i, 0, keepdims=False),
+            micro_extras))
+
+    ex0 = slice_extras(jnp.zeros((), jnp.int32))
+    rng0 = None if rng is None else rng
+    out0 = jax.eval_shape(
+        functools.partial(fwd, ex=ex0, r=rng0),
+        jax.ShapeDtypeStruct((mb, *x.shape[1:]), x.dtype))
     shift = [(i, i + 1) for i in range(p - 1)]  # non-circular stage hop
 
     def tick(carry, t):
@@ -72,7 +114,12 @@ def pipeline_apply(block_fn: Callable, stacked_params: PyTree, x: jax.Array, *,
         inject = lax.dynamic_index_in_dim(micro, jnp.minimum(t, m - 1), 0,
                                           keepdims=False)
         inp = jnp.where(stage == 0, inject.astype(out0.dtype), current)
-        out = fwd(inp)
+        # This stage processes microbatch t - stage at tick t; extras index
+        # locally (clipped — bubble ticks compute on garbage that never
+        # reaches an output, the SPMD uniformity trade).
+        i = jnp.clip(t - stage, 0, m - 1)
+        r = None if rng is None else jax.random.fold_in(rng, i)
+        out = fwd(inp, slice_extras(i), r)
         nxt = lax.ppermute(out, axis_name, shift)
         midx = t - (p - 1)
         updated = lax.dynamic_update_index_in_dim(
@@ -90,6 +137,173 @@ def pipeline_apply(block_fn: Callable, stacked_params: PyTree, x: jax.Array, *,
     return outputs.reshape(b, *out0.shape[1:])
 
 
+def pipeline_value_and_grad_1f1b(
+        block_fn: Callable, loss_mb_fn: Callable, stacked_params: PyTree,
+        head_params: PyTree, x: jax.Array, loss_aux: PyTree, *,
+        num_microbatches: int, axis_name: str = "pipeline",
+        extras: PyTree = None, rng: jax.Array | None = None,
+        reduce_axes: tuple[str, ...] = ()) -> tuple:
+    """One-f1b (one-forward-one-backward) pipelined loss+gradient — call
+    inside ``shard_map``.
+
+    Unlike the GPipe path (forward schedule + autodiff transpose, which
+    stores one activation per microbatch per stage — O(M) — before any
+    backward runs), this schedule interleaves: each tick runs one
+    microbatch-forward AND one microbatch-backward slot on every stage, so
+    a microbatch's stored stage input is freed 2(P - stage) - 1 ticks after
+    it is saved and the activation ring buffer holds min(M, 2P) entries —
+    O(P), independent of microbatch count. The uniform-tick SPMD form pays
+    for this with a longer drain: (2P-1)/(M+2P-1) bubble vs GPipe's
+    (P-1)/(M+P-1); 1F1B is the memory schedule, GPipe the latency schedule
+    (both measured in BENCHMARKS.md).
+
+    - ``block_fn`` as in :func:`pipeline_apply` (2- or 4-arg form).
+    - ``loss_mb_fn(head_params, y_mb, aux_mb) -> (scalar, aux_scalars)``:
+      the last stage's per-microbatch loss CONTRIBUTION plus a pytree of
+      scalar metric contributions (both pre-normalized so contributions sum
+      to the batch value — normalizers like total mask count must be closed
+      over, they are known before the schedule runs).
+    - ``loss_aux``: pytree of per-example loss inputs (leaves [B, ...]),
+      microbatch-sliced at the last stage.
+    - ``reduce_axes``: extra mesh axes (e.g. the data axis) to psum loss
+      and gradients over — contributions are pre-normalized by GLOBAL
+      totals, so the cross-shard reduction is a sum.
+
+    Returns ``(loss, aux_scalars, grads_stacked, grads_head, dx)``:
+    *grads_stacked* is this stage's shard of the layer-stack gradients;
+    *grads_head*, *loss*, and the accumulated *aux_scalars* are replicated
+    over the pipeline axis; *dx* is the cotangent of *x* (for the caller's
+    embedding backward), replicated likewise.
+    """
+    p = lax.axis_size(axis_name)
+    stage = lax.axis_index(axis_name)
+    m = num_microbatches
+    b = x.shape[0]
+    if b % m:
+        raise ValueError(f"batch {b} not divisible by {m} microbatches")
+    mb = b // m
+    micro = x.reshape(m, mb, *x.shape[1:])
+    micro_aux = jax.tree.map(lambda a: a.reshape(m, mb, *a.shape[1:]),
+                             loss_aux)
+    micro_extras = (None if extras is None else jax.tree.map(
+        lambda a: a.reshape(m, mb, *a.shape[1:]), extras))
+    n_local = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
+    layer_offset = stage * n_local
+    k_slots = min(m, 2 * p)   # ring-buffer depth (see docstring)
+
+    def stage_fwd(params_, inp, ex, r):
+        return _apply_local_stack(block_fn, params_, inp, ex, r,
+                                  layer_offset)
+
+    def slice_tree(tree, i):
+        return (None if tree is None else jax.tree.map(
+            lambda a: lax.dynamic_index_in_dim(a, i, 0, keepdims=False),
+            tree))
+
+    i0 = jnp.zeros((), jnp.int32)
+    out0 = jax.eval_shape(
+        functools.partial(stage_fwd, ex=slice_tree(micro_extras, i0),
+                          r=rng),
+        stacked_params, jax.ShapeDtypeStruct((mb, *x.shape[1:]), x.dtype))
+    fwd_shift = [(i, i + 1) for i in range(p - 1)]
+    bwd_shift = [(i, i - 1) for i in range(1, p)]
+    zeros_like_tree = functools.partial(jax.tree.map,
+                                        lambda a: jnp.zeros(a.shape, a.dtype))
+
+    def tick(carry, t):
+        (fwd_cur, pending_dy, bwd_cur, act_buf, g_blocks, g_head,
+         loss_acc, aux_acc, dx_out) = carry
+
+        # ---- forward slot: microbatch i = t - stage -------------------
+        i = t - stage
+        i_c = jnp.clip(i, 0, m - 1)
+        fwd_valid = (i >= 0) & (i < m)
+        inject = lax.dynamic_index_in_dim(micro, i_c, 0, keepdims=False)
+        x_in = jnp.where(stage == 0, inject.astype(out0.dtype), fwd_cur)
+        ex_i = slice_tree(micro_extras, i_c)
+        r_i = None if rng is None else jax.random.fold_in(rng, i_c)
+        y = stage_fwd(stacked_params, x_in, ex_i, r_i)
+        # Save the stage INPUT for the backward's recompute-vjp; ring slot
+        # i % k_slots is free again by the time i + k_slots arrives.
+        upd = lax.dynamic_update_index_in_dim(act_buf, x_in,
+                                              i_c % k_slots, 0)
+        act_buf = jnp.where(fwd_valid, upd, act_buf)
+        nxt_fwd = lax.ppermute(y, axis_name, fwd_shift)
+
+        # ---- last stage: loss + cotangent for the microbatch whose
+        # forward just finished (consumed by next tick's backward slot)
+        aux_i = slice_tree(micro_aux, i_c)
+        loss_i, head_vjp, metrics_i = jax.vjp(
+            lambda hp, y_: loss_mb_fn(hp, y_, aux_i), head_params, y,
+            has_aux=True)
+        dhead_i, dy_i = head_vjp(jnp.ones((), loss_i.dtype))
+        dy_i = dy_i.astype(out0.dtype)   # cotangents ride in activation dtype
+        last_valid = fwd_valid & (stage == p - 1)
+        loss_acc = loss_acc + jnp.where(last_valid, loss_i, 0.0)
+        aux_acc = jax.tree.map(
+            lambda a, v: a + jnp.where(last_valid, v, 0.0), aux_acc,
+            metrics_i)
+        g_head = jax.tree.map(
+            lambda g, d: g + jnp.where(last_valid, d, 0), g_head, dhead_i)
+
+        # ---- backward slot: microbatch j = t - 2p + 1 + stage ---------
+        j = t - 2 * p + 1 + stage
+        j_c = jnp.clip(j, 0, m - 1)
+        bwd_valid = (j >= 0) & (j < m)
+        dy = jnp.where(stage == p - 1, pending_dy, bwd_cur)
+        x_saved = lax.dynamic_index_in_dim(act_buf, j_c % k_slots, 0,
+                                           keepdims=False)
+        ex_j = slice_tree(micro_extras, j_c)
+        r_j = None if rng is None else jax.random.fold_in(rng, j_c)
+        _, stage_vjp = jax.vjp(
+            lambda pr, xi: stage_fwd(pr, xi, ex_j, r_j),
+            stacked_params, x_saved)
+        dparams_j, dx_j = stage_vjp(dy.astype(out0.dtype))
+        g_blocks = jax.tree.map(
+            lambda g, d: g + jnp.where(bwd_valid, d, 0), g_blocks, dparams_j)
+        nxt_bwd = lax.ppermute(dx_j, axis_name, bwd_shift)
+        # Stage 0's dx is the embedding cotangent — record it.
+        upd_dx = lax.dynamic_update_index_in_dim(dx_out, dx_j, j_c, 0)
+        dx_out = jnp.where(bwd_valid & (stage == 0), upd_dx, dx_out)
+
+        return (nxt_fwd, dy_i, nxt_bwd, act_buf, g_blocks, g_head,
+                loss_acc, aux_acc, dx_out), None
+
+    aux0 = jax.eval_shape(
+        lambda: loss_mb_fn(head_params,
+                           jnp.zeros(out0.shape, out0.dtype),
+                           slice_tree(micro_aux, i0))[1])
+    carry0 = (
+        jnp.zeros(out0.shape, out0.dtype),                  # fwd_cur
+        jnp.zeros(out0.shape, out0.dtype),                  # pending_dy
+        jnp.zeros(out0.shape, out0.dtype),                  # bwd_cur
+        jnp.zeros((k_slots, *out0.shape), out0.dtype),      # act ring
+        zeros_like_tree(stacked_params),                    # block grads
+        zeros_like_tree(head_params),                       # head grads
+        jnp.zeros((), jnp.float32),                         # loss
+        jax.tree.map(lambda a: jnp.zeros(a.shape, a.dtype), aux0),
+        jnp.zeros((m, *out0.shape), out0.dtype),            # dx per mb
+    )
+    (_, _, _, _, g_blocks, g_head, loss, aux, dx_out), _ = lax.scan(
+        tick, carry0, jnp.arange(m + 2 * p - 1))
+
+    # loss/head grads are real on the last stage, dx on stage 0: rebroadcast.
+    last = stage == p - 1
+    loss = lax.psum(jnp.where(last, loss, 0.0), axis_name)
+    aux = jax.tree.map(
+        lambda a: lax.psum(jnp.where(last, a, 0.0), axis_name), aux)
+    g_head = jax.tree.map(
+        lambda g: lax.psum(jnp.where(last, g, 0), axis_name), g_head)
+    dx = lax.psum(jnp.where(stage == 0, dx_out, 0), axis_name)
+    for ax in reduce_axes:
+        loss = lax.psum(loss, ax)
+        aux = jax.tree.map(lambda a: lax.psum(a, ax), aux)
+        g_head = jax.tree.map(lambda g: lax.psum(g, ax), g_head)
+        g_blocks = jax.tree.map(lambda g: lax.psum(g, ax), g_blocks)
+        # dx stays batch-local: its batch dim is sharded over the data axis.
+    return loss, aux, g_blocks, g_head, dx.reshape(b, *out0.shape[1:])
+
+
 def pipeline_loss(per_example_loss: Callable, axis_name: str = "pipeline"):
     """Wrap a loss over pipeline outputs so each stage computes it and the
     pmean makes value and gradients exact (see module docstring)."""
@@ -100,19 +314,31 @@ def pipeline_loss(per_example_loss: Callable, axis_name: str = "pipeline"):
 
 def make_pipeline_fn(mesh: Mesh, block_fn: Callable, *,
                      num_microbatches: int, axis_name: str = "pipeline",
-                     data_axes: tuple[str, ...] = ("data",)) -> Callable:
-    """Jit-level wrapper: ``fn(stacked_params, x) -> y`` with params sharded
-    over the pipeline axis (leading/layers dim) and batch over *data_axes*."""
+                     data_axes: tuple[str, ...] = ("data",),
+                     with_extras: bool = False,
+                     with_rng: bool = False) -> Callable:
+    """Jit-level wrapper: ``fn(stacked_params, x[, extras][, rng]) -> y``
+    with params sharded over the pipeline axis (leading/layers dim), batch
+    (and extras leaves) over *data_axes*, rng replicated."""
     batch = tuple(a for a in data_axes if a in mesh.axis_names) or None
     pspec = P(axis_name)          # layer-stacked leaves: shard leading dim
     xspec = P(batch)
 
-    def inner(stacked_params, x):
+    in_specs = [pspec, xspec]
+    if with_extras:
+        in_specs.append(xspec)    # broadcast over the extras pytree
+    if with_rng:
+        in_specs.append(P())
+
+    def inner(stacked_params, x, *rest):
+        rest = list(rest)
+        extras = rest.pop(0) if with_extras else None
+        rng = rest.pop(0) if with_rng else None
         return pipeline_apply(block_fn, stacked_params, x,
                               num_microbatches=num_microbatches,
-                              axis_name=axis_name)
+                              axis_name=axis_name, extras=extras, rng=rng)
 
     return jax.jit(jax.shard_map(
         inner, mesh=mesh,
-        in_specs=(pspec, xspec), out_specs=xspec,
+        in_specs=tuple(in_specs), out_specs=xspec,
         check_vma=False))

@@ -37,20 +37,32 @@ PyTree = Any
 
 
 def block_fn_from_config(cfg: tfm.TransformerConfig) -> Callable:
-    """``block_fn(one_layer_params, x) -> x`` for ``pipeline_apply``: one
-    pre-norm transformer Block applied functionally to a single layer's
-    slice of the scan-stacked weights. ``cfg.remat`` checkpoints each layer
-    (the backward recomputes the block instead of storing activations —
-    per-stage memory then scales with layers/stage, not layers)."""
+    """``block_fn`` for ``pipeline_apply``: one pre-norm transformer Block
+    applied functionally to a single layer's slice of the scan-stacked
+    weights. Called as ``block_fn(layer_params, x)`` on the plain path, or
+    ``block_fn(layer_params, x, extras, rng)`` when the schedule threads
+    packed-sequence extras (``{"segment_ids", "positions"}``) and/or a
+    dropout rng through (``pipeline_apply`` folds the rng per (microbatch,
+    global layer), so masks are independent exactly like the scan stack's
+    ``split_rngs``). ``cfg.remat`` checkpoints each layer (the backward
+    recomputes the block instead of storing activations — per-stage memory
+    then scales with layers/stage, not layers)."""
     block = tfm.Block(cfg)
 
-    def block_fn(layer_params, x):
-        return block.apply({"params": layer_params}, x)
+    def block_fn(layer_params, x, extras=None, rng=None):
+        kwargs = {}
+        if extras is not None:
+            kwargs["segment_ids"] = extras["segment_ids"]
+            kwargs["positions"] = extras["positions"]
+        rngs = None if rng is None else {"dropout": rng}
+        return block.apply({"params": layer_params}, x,
+                           deterministic=rng is None, rngs=rngs, **kwargs)
 
     if cfg.remat:
         # Same policy knob as the scan/remat stack (cfg.remat_policy).
         return jax.checkpoint(block_fn,
-                              policy=tfm.REMAT_POLICIES[cfg.remat_policy])
+                              policy=tfm.REMAT_POLICIES[cfg.remat_policy],
+                              static_argnums=())
     return block_fn
 
 
@@ -59,36 +71,73 @@ def _check_supported(cfg: tfm.TransformerConfig, batch: PyTree | None = None):
         raise ValueError(
             "pipeline parallelism consumes the nn.scan-stacked layer layout; "
             "set scan_layers=True (the default)")
-    if cfg.dropout_rate:
+    if batch is not None and "segment_ids" in batch \
+            and cfg.position == "learned":
         raise NotImplementedError(
-            "dropout on the pipeline path is not supported yet (block_fn "
-            "applies layers deterministically — silently skipping dropout "
-            "would diverge from the sharded trainer); set dropout_rate=0")
-    if batch is not None and "segment_ids" in batch:
-        raise NotImplementedError(
-            "packed-sequence (segment_ids) batches are not supported on the "
-            "pipeline path yet — the per-layer block_fn would need the "
-            "segment mask threaded through the schedule")
+            "packed sequences on the pipeline path support rope/none "
+            "positions only (learned positions would need packed indices at "
+            "the embedding, outside the schedule)")
+
+
+def _prepare_lm_batch(batch: PyTree):
+    """Shared next-token-CE batch preamble for both schedules: shift,
+    default mask, and (packed) cross-document boundary exclusion — one copy
+    so the gpipe and 1f1b losses cannot drift."""
+    tokens = batch["tokens"]
+    inputs, targets = tokens[:, :-1], tokens[:, 1:]
+    seg = batch.get("segment_ids")
+    seg_in = None if seg is None else seg[:, :-1]
+    mask = batch.get("mask")
+    mask = (jnp.ones_like(targets, jnp.float32) if mask is None
+            else mask[:, 1:])
+    if seg is not None:
+        # Position i predicts i+1: only count pairs inside one document.
+        mask = mask * (seg[:, :-1] == seg[:, 1:]).astype(jnp.float32)
+    return inputs, targets, seg_in, mask
+
+
+def _head_logits(x: jax.Array, w: jax.Array, layout: str,
+                 dtype) -> jax.Array:
+    """The head-weight layout contract, in one place (``unembedding`` owns
+    the layout codes): "vd" = tied embedding table, "dv" = LMHead kernel —
+    same matmul precision as ``LMHead`` (bf16 MXU inputs, f32 out)."""
+    if layout == "vd":
+        return jnp.einsum("bsd,vd->bsv", x, w.astype(dtype),
+                          preferred_element_type=jnp.float32)
+    return (x @ w.astype(dtype)).astype(jnp.float32)
 
 
 def make_hidden_fn(model, mesh: Mesh, *, num_microbatches: int,
                    axis_name: str = "pipeline",
                    data_axes: tuple[str, ...] = ("data",)) -> Callable:
-    """``fn(params, tokens) -> [B, S, D] final hidden states`` (post
-    final-norm) with the layer stack pipelined over *axis_name*. *params* is
-    the (boxed or unboxed) tree from ``model.init`` — the scan-stacked
-    "blocks" subtree feeds the schedule; embed/norm replicate."""
+    """``fn(params, tokens, segment_ids=None, rng=None) -> [B, S, D]`` final
+    hidden states (post final-norm) with the layer stack pipelined over
+    *axis_name*. *params* is the (boxed or unboxed) tree from ``model.init``
+    — the scan-stacked "blocks" subtree feeds the schedule; embed/norm
+    replicate. ``segment_ids`` enables packed-sequence batches (segment-
+    masked attention + per-document RoPE positions threaded through the
+    schedule); ``rng`` enables dropout."""
     import flax.linen as nn
 
     cfg = model.cfg
     _check_supported(cfg)
-    pipe = pipeline.make_pipeline_fn(
-        mesh, block_fn_from_config(cfg),
-        num_microbatches=num_microbatches,
-        axis_name=axis_name, data_axes=data_axes)
+    block_fn = block_fn_from_config(cfg)
+    pipes = {}  # (packed, stochastic) -> compiled schedule wrapper
+
+    def pipe_for(packed: bool, stochastic: bool):
+        key = (packed, stochastic)
+        if key not in pipes:
+            pipes[key] = pipeline.make_pipeline_fn(
+                mesh, block_fn, num_microbatches=num_microbatches,
+                axis_name=axis_name, data_axes=data_axes,
+                with_extras=packed, with_rng=stochastic)
+        return pipes[key]
+
     norm = tfm.make_norm(cfg, None)
 
-    def fn(params, tokens):
+    def fn(params, tokens, segment_ids=None, rng=None):
+        _check_supported(cfg, None if segment_ids is None
+                         else {"segment_ids": segment_ids})
         params = nn.meta.unbox(params)
         tp = params["transformer"]
         emb = tp["tok_embed"]["embedding"]
@@ -97,7 +146,13 @@ def make_hidden_fn(model, mesh: Mesh, *, num_microbatches: int,
             pos = tp["pos_embed"]["embedding"]
             x = x + jnp.take(pos, jnp.arange(tokens.shape[1]), axis=0
                              ).astype(cfg.dtype)
-        x = pipe(tp["blocks"], x)
+        args = [tp["blocks"], x]
+        if segment_ids is not None:
+            args.append({"segment_ids": segment_ids,
+                         "positions": tfm.packed_positions(segment_ids)})
+        if rng is not None:
+            args.append(rng)
+        x = pipe_for(segment_ids is not None, rng is not None)(*args)
         return norm.apply({"params": tp["final_norm"]}, x)
 
     return fn
@@ -106,36 +161,39 @@ def make_hidden_fn(model, mesh: Mesh, *, num_microbatches: int,
 def make_logits_fn(model, mesh: Mesh, *, num_microbatches: int,
                    axis_name: str = "pipeline",
                    data_axes: tuple[str, ...] = ("data",)) -> Callable:
-    """``fn(params, tokens) -> [B, S, V] f32 logits`` with the layer stack
-    pipelined over *axis_name*. Numerics match ``model.apply`` (same
-    modules, functionally applied)."""
+    """``fn(params, tokens, segment_ids=None, rng=None) -> [B, S, V]`` f32
+    logits with the layer stack pipelined over *axis_name*. Numerics match
+    ``model.apply`` (same modules, functionally applied)."""
     import flax.linen as nn
 
     cfg = model.cfg
     hidden = make_hidden_fn(model, mesh, num_microbatches=num_microbatches,
                             axis_name=axis_name, data_axes=data_axes)
 
-    def fn(params, tokens):
-        x = hidden(params, tokens)
+    def fn(params, tokens, segment_ids=None, rng=None):
+        x = hidden(params, tokens, segment_ids, rng)
         # One source of truth for the head-weight layout contract.
         from k8s_distributed_deeplearning_tpu.models.llama import unembedding
         w, layout = unembedding(cfg, nn.meta.unbox(params))
-        if layout == "vd":
-            logits = jnp.einsum("bsd,vd->bsv", x, w.astype(cfg.dtype),
-                                preferred_element_type=jnp.float32)
-        else:
-            # Same contraction LMHead's DenseGeneral performs (bf16 matmul,
-            # f32 upcast after) so PP and non-PP losses agree bit-for-bit
-            # at f32 and to bf16 tolerance otherwise.
-            logits = (x @ w.astype(cfg.dtype)).astype(jnp.float32)
-        return logits.astype(jnp.float32)
+        return _head_logits(x, w, layout, cfg.dtype).astype(jnp.float32)
 
     return fn
 
 
 class PipelineTrainer:
-    """GPipe × DP trainer with the ShardedTrainer surface (init / make_step /
-    shard_batch) so the training CLIs can swap engines on a flag.
+    """Pipeline × DP trainer with the ShardedTrainer surface (init /
+    make_step / shard_batch) so the training CLIs can swap engines on a
+    flag.
+
+    ``schedule`` picks the pipeline schedule:
+
+    - ``"gpipe"`` (default): forward schedule + autodiff transpose. Stores
+      one activation per microbatch per stage before backward starts
+      (O(M) memory); bubble (P-1)/(M+P-1) — the latency schedule.
+    - ``"1f1b"``: interleaved one-forward-one-backward
+      (:func:`parallel.pipeline.pipeline_value_and_grad_1f1b`). Activation
+      ring buffer bounded at min(M, 2P) entries (O(P) — the memory
+      schedule, for M >> P); uniform-tick bubble (2P-1)/(M+2P-1).
 
     Mesh must carry *axis_name* (pipeline stages; must divide
     ``cfg.n_layers``) and may carry *data_axes* (batch sharding). Other
@@ -147,9 +205,17 @@ class PipelineTrainer:
                  mesh: Mesh, *, num_microbatches: int,
                  axis_name: str = "pipeline",
                  data_axes: tuple[str, ...] = ("data",),
-                 chunked_ce: bool = False, chunk_size: int = 1024):
+                 chunked_ce: bool = False, chunk_size: int = 1024,
+                 schedule: str = "gpipe"):
         cfg = model.cfg
         _check_supported(cfg)
+        if schedule not in ("gpipe", "1f1b"):
+            raise ValueError(f"schedule must be 'gpipe' or '1f1b', "
+                             f"got {schedule!r}")
+        if schedule == "1f1b" and cfg.position == "learned":
+            raise NotImplementedError(
+                "1f1b owns the embedding backward and supports rope/none "
+                "positions only")
         stages = mesh.shape[axis_name]
         if cfg.n_layers % stages:
             raise ValueError(
@@ -163,6 +229,7 @@ class PipelineTrainer:
         self.num_microbatches = num_microbatches
         self.chunked_ce = chunked_ce
         self.chunk_size = chunk_size
+        self.schedule = schedule
         self._hidden_fn = make_hidden_fn(
             model, mesh, num_microbatches=num_microbatches,
             axis_name=axis_name, data_axes=data_axes)
@@ -202,8 +269,10 @@ class PipelineTrainer:
 
     # -- loss / step -------------------------------------------------------
     def loss_fn(self, params, batch, rng=None):
-        """Shifted next-token CE on pipelined hidden states; same contract as
-        ``llama.loss_fn`` (mask honored; no packed segments on this path).
+        """Shifted next-token CE on pipelined hidden states; same contract
+        as ``llama.loss_fn``: optional "mask", optional packed
+        "segment_ids" (segment-masked attention, per-document RoPE,
+        cross-document pairs out of the loss), optional dropout *rng*.
         ``chunked_ce=True`` runs the LM head through
         :func:`ops.chunked_ce.chunked_softmax_cross_entropy` so the
         ``[B, S, V]`` logits tensor never materializes (the long-vocab
@@ -212,41 +281,155 @@ class PipelineTrainer:
         from k8s_distributed_deeplearning_tpu.models.llama import unembedding
 
         _check_supported(self.model.cfg, batch)
-        tokens = batch["tokens"]
-        inputs, targets = tokens[:, :-1], tokens[:, 1:]
-        mask = batch.get("mask")
-        mask = (jnp.ones_like(targets, jnp.float32) if mask is None
-                else mask[:, 1:])
+        # Only thread the rng through the schedule when the model actually
+        # has stochastic layers — a live rng switches the pipeline to its
+        # stochastic compiled variant.
+        if not self.model.cfg.dropout_rate:
+            rng = None
+        inputs, targets, seg_in, mask = _prepare_lm_batch(batch)
 
         if self.chunked_ce:
             from k8s_distributed_deeplearning_tpu.ops.chunked_ce import (
                 chunked_softmax_cross_entropy)
-            x = self._hidden_fn(params, inputs)
+            x = self._hidden_fn(params, inputs, seg_in, rng)
             w, layout = unembedding(self.model.cfg, nn.meta.unbox(params))
             loss, acc = chunked_softmax_cross_entropy(
                 x, w, targets, mask, chunk_size=self.chunk_size,
                 w_layout=layout)
             return loss, {"accuracy": acc, "perplexity": jnp.exp(loss)}
 
-        logits = self._logits_fn(params, inputs)
+        logits = self._logits_fn(params, inputs, seg_in, rng)
         ce = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
         loss = (ce * mask).sum() / jnp.maximum(mask.sum(), 1.0)
         acc = (((logits.argmax(-1) == targets) * mask).sum()
                / jnp.maximum(mask.sum(), 1.0))
         return loss, {"accuracy": acc, "perplexity": jnp.exp(loss)}
 
+    # -- 1f1b engine -------------------------------------------------------
+    def _value_and_grad_1f1b(self, params, batch, rng=None):
+        """Loss + full param gradients through the interleaved 1F1B
+        schedule. The schedule owns embedding forward/backward and the
+        head-side loss; gradients are reassembled into the params tree."""
+        import flax.linen as nn
+        from k8s_distributed_deeplearning_tpu.models.llama import unembedding
+
+        cfg = self.model.cfg
+        _check_supported(cfg, batch)
+        if not cfg.dropout_rate:
+            rng = None
+        params = nn.meta.unbox(params)
+        inputs, targets, seg_in, mask = _prepare_lm_batch(batch)
+        total_mask = jnp.maximum(mask.sum(), 1.0)   # known pre-schedule
+
+        tp = params["transformer"]
+        w, layout = unembedding(cfg, params)
+        head_side = {"final_norm": tp["final_norm"], "unembed": w}
+        norm = tfm.make_norm(cfg, None)
+        chunked, chunk_size = self.chunked_ce, self.chunk_size
+
+        def loss_mb_fn(hp, y_mb, aux_mb, tm):
+            # Per-microbatch CONTRIBUTIONS: (ce*mask).sum()/tm and the
+            # weighted-correct count /tm, so contributions sum to exactly
+            # the batch loss/accuracy (tm = the global mask count, known
+            # before the schedule runs).
+            x = norm.apply({"params": hp["final_norm"]}, y_mb)
+            mb_mask = aux_mb["mask"]
+            if chunked:
+                from k8s_distributed_deeplearning_tpu.ops.chunked_ce import (
+                    chunked_softmax_cross_entropy)
+                l_norm, acc = chunked_softmax_cross_entropy(
+                    x, hp["unembed"], aux_mb["targets"], mb_mask,
+                    chunk_size=chunk_size, w_layout=layout)
+                # chunked_softmax_cross_entropy normalizes by
+                # max(mask.sum(), 1.0) — multiply the same factor back.
+                denom = jnp.maximum(mb_mask.sum(), 1.0)
+                return l_norm * denom / tm, {"accuracy": acc * denom / tm}
+            logits = _head_logits(x, hp["unembed"], layout, cfg.dtype)
+            ce = optax.softmax_cross_entropy_with_integer_labels(
+                logits.astype(jnp.float32), aux_mb["targets"])
+            correct = ((logits.argmax(-1) == aux_mb["targets"])
+                       * mb_mask).sum()
+            return ((ce * mb_mask).sum() / tm,
+                    {"accuracy": correct / tm})
+
+        block_fn = block_fn_from_config(cfg)
+        packed = seg_in is not None
+        stochastic = rng is not None
+        axis, m = self.axis_name, self.num_microbatches
+        pspec = P(axis)
+        xspec = P(self.data_axes or None)
+        in_specs = [pspec, P(), xspec, xspec, P()]
+        if packed:
+            in_specs.append(xspec)
+        if stochastic:
+            in_specs.append(P())
+
+        def inner(blocks, head, x, aux, tm, *rest):
+            rest = list(rest)
+            extras = rest.pop(0) if packed else None
+            r = rest.pop(0) if stochastic else None
+            return pipeline.pipeline_value_and_grad_1f1b(
+                block_fn,
+                lambda hp, y, a: loss_mb_fn(hp, y, a, tm),
+                blocks, head, x, aux,
+                num_microbatches=m, axis_name=axis, extras=extras, rng=r,
+                reduce_axes=self.data_axes)
+
+        sharded = jax.shard_map(
+            inner, mesh=self.mesh,
+            in_specs=tuple(in_specs),
+            out_specs=(P(), P(), pspec, P(), xspec),
+            check_vma=False)
+
+        emb = tp["tok_embed"]["embedding"]
+        x = jnp.take(emb, inputs, axis=0).astype(cfg.dtype)
+        aux_tree = {"targets": targets, "mask": mask}
+        args = [tp["blocks"], head_side, x, aux_tree, total_mask]
+        if packed:
+            args.append({"segment_ids": seg_in,
+                         "positions": tfm.packed_positions(seg_in)})
+        if stochastic:
+            args.append(rng)
+        loss, metrics, g_blocks, g_head, dx = sharded(*args)
+
+        # Embedding backward (the schedule returns the input cotangent).
+        g_emb = jnp.zeros(emb.shape, emb.dtype).at[inputs].add(
+            dx.astype(emb.dtype))
+        if cfg.tie_embeddings:
+            g_emb = g_emb + g_head["unembed"].astype(emb.dtype)
+        grads = {"transformer": {"tok_embed": {"embedding": g_emb},
+                                 "blocks": g_blocks,
+                                 "final_norm": g_head["final_norm"]}}
+        if not cfg.tie_embeddings:
+            grads["head"] = {"lm_head": {"kernel": g_head["unembed"]}}
+        return loss, {"accuracy": metrics["accuracy"],
+                      "perplexity": jnp.exp(loss)}, grads
+
     def make_step(self, donate: bool = True) -> Callable:
         opt = self.optimizer
 
         def step(state: TrainState, batch: PyTree, rng: jax.Array):
-            (loss, aux), grads = jax.value_and_grad(
-                self.loss_fn, has_aux=True)(state.params, batch, rng)
+            if self.schedule == "1f1b":
+                loss, aux, grads = self._value_and_grad_1f1b(
+                    state.params, batch, rng)
+            else:
+                (loss, aux), grads = jax.value_and_grad(
+                    self.loss_fn, has_aux=True)(state.params, batch, rng)
             updates, opt_state = opt.update(grads, state.opt_state,
                                             state.params)
             params = optax.apply_updates(state.params, updates)
             return (TrainState(params, opt_state, state.step + 1), loss, aux)
 
         return jax.jit(step, donate_argnums=(0,) if donate else ())
+
+    def value_and_grad(self, params, batch, rng=None):
+        """(loss, aux, grads) through the configured schedule — the 1f1b
+        parity-test surface (gpipe goes through autodiff)."""
+        if self.schedule == "1f1b":
+            return self._value_and_grad_1f1b(params, batch, rng)
+        (loss, aux), grads = jax.value_and_grad(
+            self.loss_fn, has_aux=True)(params, batch, rng)
+        return loss, aux, grads
 
     def shard_batch(self, batch: PyTree) -> PyTree:
         sh = NamedSharding(self.mesh, P(self.data_axes or None))

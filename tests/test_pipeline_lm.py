@@ -168,10 +168,180 @@ def test_pp_rejects_bad_configs():
     with pytest.raises(ValueError, match="scan_layers"):
         pipeline_lm.PipelineTrainer(llama.LlamaLM(cfg2), optax.sgd(0.1),
                                     mesh, num_microbatches=2)
-    cfg3 = _cfg()
+    # Packed batches are supported on the rope path (see
+    # test_pp_packed_matches_sharded_trainer); the remaining guard is
+    # learned positions, whose packed indices live outside the schedule.
+    cfg3 = _cfg(position="learned")
     tr = pipeline_lm.PipelineTrainer(llama.LlamaLM(cfg3), optax.sgd(0.1),
                                      mesh, num_microbatches=4)
     batch = _batch()
     batch["segment_ids"] = jnp.zeros_like(batch["tokens"])
-    with pytest.raises(NotImplementedError, match="segment_ids"):
+    with pytest.raises(NotImplementedError, match="learned"):
         tr.loss_fn(jax.eval_shape(lambda: None), batch)
+
+
+def test_pp_packed_matches_sharded_trainer():
+    """Packed-sequence batches on the pipeline path (guard lifted in round
+    3): segment-masked attention + per-document RoPE threaded through the
+    schedule must reproduce llama.loss_fn's packed loss and gradients."""
+    cfg = _cfg()
+    model = llama.LlamaLM(cfg)
+    mesh = mesh_lib.make_mesh({"pipeline": 4, "data": 2})
+    tr = pipeline_lm.PipelineTrainer(model, optax.sgd(0.1), mesh,
+                                     num_microbatches=4)
+    import flax.linen as nn
+    params = nn.meta.unbox(model.init(jax.random.key(0),
+                                      jnp.zeros((1, 8), jnp.int32))["params"])
+    batch = _batch(b=8, s=17)
+    # Two packed documents per row, boundary varying by row.
+    s = batch["tokens"].shape[1]
+    cut = 5 + (np.arange(8) % 4)
+    seg = np.zeros((8, s), np.int32)
+    for r, c in enumerate(cut):
+        seg[r, c:] = 1
+    batch["segment_ids"] = jnp.asarray(seg)
+
+    loss_pp, _ = tr.loss_fn(params, batch)
+    loss_ref, _ = llama.loss_fn(model, params, batch)
+    np.testing.assert_allclose(float(loss_pp), float(loss_ref), rtol=1e-5)
+
+    g_pp = jax.grad(lambda p: tr.loss_fn(p, batch)[0])(params)
+    g_ref = jax.grad(lambda p: llama.loss_fn(model, p, batch)[0])(params)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=5e-4, atol=1e-5),
+        g_pp, g_ref)
+
+
+def test_pp_dropout_trains_deterministically():
+    """Dropout on the pipeline path (guard lifted in round 3): a live rng
+    produces a stochastic loss that (a) is reproducible given the same rng,
+    (b) differs for a different rng, and (c) trains."""
+    cfg = _cfg(dropout_rate=0.3)
+    model = llama.LlamaLM(cfg)
+    mesh = mesh_lib.make_mesh({"pipeline": 4, "data": 2})
+    tr = pipeline_lm.PipelineTrainer(model, optax.adam(1e-2), mesh,
+                                     num_microbatches=4)
+    state = tr.init(
+        lambda r: model.init(r, jnp.zeros((1, 8), jnp.int32))["params"],
+        jax.random.key(0))
+    import flax.linen as nn
+    params = nn.meta.unbox(state.params)
+    batch = _batch()
+
+    l1, _ = tr.loss_fn(params, batch, jax.random.key(1))
+    l1b, _ = tr.loss_fn(params, batch, jax.random.key(1))
+    l2, _ = tr.loss_fn(params, batch, jax.random.key(2))
+    l0, _ = tr.loss_fn(params, batch, None)   # deterministic path intact
+    assert float(l1) == float(l1b)
+    assert float(l1) != float(l2)
+    assert np.isfinite(float(l0))
+
+    step = tr.make_step(donate=False)
+    losses = []
+    for i in range(3):
+        state, loss, _ = step(state, tr.shard_batch(batch),
+                              jax.random.key(i))
+        losses.append(float(loss))
+    assert np.isfinite(losses).all() and losses[-1] < losses[0], losses
+
+
+def test_1f1b_matches_gpipe_and_reference():
+    """The 1F1B interleaved schedule must reproduce the GPipe/autodiff loss
+    and full gradient tree (which in turn matches llama.loss_fn) — same
+    math, different schedule."""
+    cfg = _cfg()
+    model = llama.LlamaLM(cfg)
+    mesh = mesh_lib.make_mesh({"pipeline": 4, "data": 2})
+    import flax.linen as nn
+    params = nn.meta.unbox(model.init(jax.random.key(0),
+                                      jnp.zeros((1, 8), jnp.int32))["params"])
+    batch = _batch()
+
+    tr_g = pipeline_lm.PipelineTrainer(model, optax.sgd(0.1), mesh,
+                                       num_microbatches=4)
+    tr_i = pipeline_lm.PipelineTrainer(model, optax.sgd(0.1), mesh,
+                                       num_microbatches=4, schedule="1f1b")
+    l_g, a_g, g_g = tr_g.value_and_grad(params, batch)
+    l_i, a_i, g_i = tr_i.value_and_grad(params, batch)
+    np.testing.assert_allclose(float(l_i), float(l_g), rtol=1e-5)
+    np.testing.assert_allclose(float(a_i["accuracy"]),
+                               float(a_g["accuracy"]), rtol=1e-5)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=5e-4, atol=1e-5),
+        g_i, g_g)
+
+
+def test_1f1b_trains_and_composes():
+    """1F1B end-to-end: training decreases the loss; packed batches and
+    chunked CE compose with the interleaved schedule."""
+    cfg = _cfg()
+    model = llama.LlamaLM(cfg)
+    mesh = mesh_lib.make_mesh({"pipeline": 4, "data": 2})
+    tr = pipeline_lm.PipelineTrainer(model, optax.adam(1e-2), mesh,
+                                     num_microbatches=4, schedule="1f1b")
+    state = tr.init(
+        lambda r: model.init(r, jnp.zeros((1, 8), jnp.int32))["params"],
+        jax.random.key(0))
+    step = tr.make_step(donate=False)
+    batch = _batch()
+    losses = []
+    for i in range(4):
+        state, loss, _ = step(state, tr.shard_batch(batch),
+                              jax.random.key(i))
+        losses.append(float(loss))
+    assert np.isfinite(losses).all() and losses[-1] < losses[0], losses
+
+    # packed + 1f1b parity against the packed gpipe path
+    import flax.linen as nn
+    params = nn.meta.unbox(model.init(jax.random.key(0),
+                                      jnp.zeros((1, 8), jnp.int32))["params"])
+    pb = _batch(b=8, s=17)
+    s = pb["tokens"].shape[1]
+    seg = np.zeros((8, s), np.int32)
+    for r, c in enumerate(5 + (np.arange(8) % 4)):
+        seg[r, c:] = 1
+    pb["segment_ids"] = jnp.asarray(seg)
+    tr_g = pipeline_lm.PipelineTrainer(model, optax.sgd(0.1), mesh,
+                                       num_microbatches=4)
+    l_g, _, g_g = tr_g.value_and_grad(params, pb)
+    l_i, _, g_i = tr.value_and_grad(params, pb)
+    np.testing.assert_allclose(float(l_i), float(l_g), rtol=1e-5)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=5e-4, atol=1e-5),
+        g_i, g_g)
+
+    # chunked CE + 1f1b
+    tr_c = pipeline_lm.PipelineTrainer(model, optax.sgd(0.1), mesh,
+                                       num_microbatches=4, schedule="1f1b",
+                                       chunked_ce=True, chunk_size=5)
+    l_c, _, g_c = tr_c.value_and_grad(params, _batch())
+    l_p, _, g_p = tr.value_and_grad(params, _batch())
+    np.testing.assert_allclose(float(l_c), float(l_p), rtol=1e-5)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=5e-4, atol=1e-5),
+        g_c, g_p)
+
+
+def test_1f1b_memory_below_gpipe():
+    """The schedule's reason to exist: at M >> P the 1F1B activation ring
+    (min(M, 2P) slots) keeps compiled per-device temp memory well below
+    GPipe's O(M) stored activations (measured 4.4 vs 28.3 MB at M=16, P=4
+    on this config)."""
+    cfg = _cfg(n_layers=8, dim=128, mlp_dim=256, max_seq_len=128,
+               vocab_size=256, remat=True)
+    model = llama.LlamaLM(cfg)
+    mesh = mesh_lib.make_mesh({"pipeline": 4, "data": 2})
+    batch = _batch(b=32, s=129, vocab=256)
+
+    def temp_mb(schedule):
+        tr = pipeline_lm.PipelineTrainer(model, optax.adam(1e-3), mesh,
+                                         num_microbatches=16,
+                                         schedule=schedule)
+        state = tr.init(lambda r: model.init(
+            r, jnp.zeros((1, 8), jnp.int32))["params"], jax.random.key(0))
+        step = tr.make_step(donate=False)
+        lowered = step.lower(state, tr.shard_batch(batch), jax.random.key(0))
+        return lowered.compile().memory_analysis().temp_size_in_bytes / 1e6
+
+    gpipe, ofob = temp_mb("gpipe"), temp_mb("1f1b")
+    assert ofob < 0.5 * gpipe, (gpipe, ofob)
