@@ -255,8 +255,6 @@ def main(argv: list[str] | None = None) -> dict:
         if use_cp:
             raise ValueError("--pack (segment ids) is not supported with "
                              "context-parallel attention yet")
-        if use_pp:
-            raise ValueError("--pack is not supported with --pp yet")
         docs = data_lib.split_documents(tokens, args.pack_sep_id,
                                         seed=conf.seed)
         batcher = data_lib.PackedTokenBatcher(
@@ -272,9 +270,51 @@ def main(argv: list[str] | None = None) -> dict:
                                         num_processes=topo.num_processes)
         metrics_extra = {}
 
+    if conf.keep_best and not conf.eval_every:
+        raise ValueError("--keep-best needs --eval-every N (best-by-metric "
+                         "retention tracks the held-out eval loss)")
+
+    # Held-out eval (in-training cadence AND the final eval share this):
+    # mean loss over up to 4 windows of the reserved corpus tail, sharded
+    # across processes like training data.
+    _eval_loss_cache: list = []
+
+    def make_eval_loss_fn():
+        # Built once, shared by the --eval-every cadence and the final
+        # eval (a second jit of the same step would recompile).
+        if _eval_loss_cache:
+            return _eval_loss_cache[0]
+        windows_per_proc = (((len(eval_tokens) - 1) // seq_len)
+                            // topo.num_processes)
+        eval_b = min(per_host, windows_per_proc)
+        if use_pp:
+            # The pipeline schedule needs the batch divisible into its
+            # microbatches; round the eval batch down.
+            m = args.pp_microbatches or args.pp
+            eval_b = (eval_b // m) * m
+        if eval_b < 1:
+            _eval_loss_cache.append(None)
+            return None
+        eval_batcher = data_lib.TokenBatcher(
+            eval_tokens, eval_b, seq_len,
+            seed=conf.seed, process_index=topo.process_index,
+            num_processes=topo.num_processes)
+        eval_step = jax.jit(lambda p, b: loss(p, b, None)[0])
+        n_batches = min(4, eval_batcher.batches_per_epoch)
+
+        def eval_loss(state):
+            vals = [float(eval_step(state.params, trainer.shard_batch(
+                eval_batcher.batch_at(s)))) for s in range(n_batches)]
+            return sum(vals) / len(vals)
+
+        _eval_loss_cache.append(eval_loss)
+        return eval_loss
+
     metrics = MetricsLogger(enabled=distributed.is_primary(), job="llama")
     ckpt = Checkpointer(conf.checkpoint_dir,
                         max_to_keep=conf.max_checkpoints_to_keep,
+                        keep_best_metric="loss" if conf.keep_best else None,
+                        best_mode="min",
                         async_save=conf.async_checkpoint)
     preemption = PreemptionHandler.install()
     profiler = (StepProfiler(args.profile_dir, start_step=10, num_steps=5,
@@ -300,6 +340,20 @@ def main(argv: list[str] | None = None) -> dict:
 
     flops_per_example = llama.flops_per_token(model_cfg,
                                               seq_len=seq_len) * seq_len
+    eval_fn = None
+    if conf.eval_every:
+        eval_loss = make_eval_loss_fn()
+        if eval_loss is None:
+            raise ValueError("--eval-every: held-out set smaller than one "
+                             "eval batch (or one pipeline microbatch "
+                             "group) per process — lower --seq-len or grow "
+                             "the corpus")
+        import math
+
+        def eval_fn(state):
+            ev = eval_loss(state)
+            return {"loss": ev, "perplexity": math.exp(ev)}
+
     try:
         state = loop.fit(
             step_fn, state, global_batches, num_steps,
@@ -310,6 +364,7 @@ def main(argv: list[str] | None = None) -> dict:
             flops_per_example=flops_per_example,
             peak_flops=mesh_lib.peak_flops_per_device(args.dtype),
             preemption=preemption, profiler=profiler,
+            eval_every=conf.eval_every, eval_fn=eval_fn,
         )
 
         result: dict = {"num_steps": int(jax.device_get(state.step)),
@@ -317,27 +372,16 @@ def main(argv: list[str] | None = None) -> dict:
         # Skip eval when preempted: the grace period is for checkpointing,
         # and an "eval" event would make an evicted run look completed.
         if conf.eval_final and not preemption.triggered:
-            # Held-out perplexity on the reserved corpus tail, sharded across
-            # processes like training data.
-            windows_per_proc = ((len(eval_tokens) - 1) // seq_len
-                                ) // topo.num_processes
-            if windows_per_proc < 1:
+            # Held-out perplexity on the reserved corpus tail (same
+            # machinery as the --eval-every cadence).
+            eval_loss = make_eval_loss_fn()
+            if eval_loss is None:
                 metrics.emit("eval_skipped",
                              reason="held-out set smaller than one window "
                              "per process")
             else:
-                eval_batcher = data_lib.TokenBatcher(
-                    eval_tokens, min(per_host, windows_per_proc), seq_len,
-                    seed=conf.seed, process_index=topo.process_index,
-                    num_processes=topo.num_processes)
-                eval_step = jax.jit(lambda p, b: loss(p, b, None)[0])
-                n_batches = min(4, eval_batcher.batches_per_epoch)
-                eval_losses = [
-                    float(eval_step(state.params, trainer.shard_batch(
-                        eval_batcher.batch_at(s))))
-                    for s in range(n_batches)]
                 import math
-                ev = sum(eval_losses) / len(eval_losses)
+                ev = eval_loss(state)
                 metrics.emit("eval", loss=ev, perplexity=math.exp(ev))
                 result["eval_loss"] = ev
     finally:

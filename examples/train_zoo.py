@@ -123,6 +123,15 @@ def main(argv: list[str] | None = None) -> dict:
             f"{topo.num_processes} processes — adjust --batch-size")
     per_host = global_batch // topo.num_processes
 
+    if conf.eval_every or conf.keep_best:
+        # Honest guard (ADVICE r2): accepting-and-ignoring these flags would
+        # mislead users into thinking best-checkpoint retention is active.
+        raise ValueError(
+            "--eval-every/--keep-best are not wired into the zoo driver "
+            "(its model families train on synthetic batches with no "
+            "held-out split); use train_llama.py or train_mnist.py for "
+            "eval-gated best-checkpoint retention")
+
     metrics = MetricsLogger(enabled=distributed.is_primary(),
                             job=f"zoo-{args.model}")
     ckpt = Checkpointer(conf.checkpoint_dir,

@@ -37,13 +37,30 @@ Dtype = Any
 
 @dataclasses.dataclass(frozen=True)
 class MoEConfig:
-    """MoE knobs layered on top of a TransformerConfig."""
+    """MoE knobs layered on top of a TransformerConfig.
+
+    ``routing`` picks the assignment policy:
+
+    - ``"topk"``: tokens pick their top-k experts; per-expert capacity
+      overflow DROPS tokens to the residual (Switch/GShard policy; needs
+      the load-balance aux loss to keep experts even).
+    - ``"expert_choice"``: experts pick their top-C tokens (Zhou et al.) —
+      every expert runs exactly full (no capacity overflow, no
+      load-balance loss needed); the dual trade is that a token may be
+      picked by no expert (it passes through the residual) or by several.
+    """
 
     num_experts: int = 8
     top_k: int = 2
     capacity_factor: float = 1.25
     aux_loss_weight: float = 0.01
     router_z_weight: float = 1e-3
+    routing: str = "topk"            # "topk" | "expert_choice"
+
+    def __post_init__(self):
+        if self.routing not in ("topk", "expert_choice"):
+            raise ValueError(f"routing must be 'topk' or 'expert_choice', "
+                             f"got {self.routing!r}")
 
 
 def top_k_routing(logits: jax.Array, k: int, capacity: int):
@@ -99,6 +116,32 @@ def top_k_routing(logits: jax.Array, k: int, capacity: int):
     return dispatch, combine, aux
 
 
+def expert_choice_routing(logits: jax.Array, capacity: int):
+    """Expert-choice routing (static shapes, no drops from overflow).
+
+    logits: [T, E] router scores. Each expert takes its top-``capacity``
+    tokens by affinity — ``lax.top_k`` over the token axis — so utilization
+    is 100% by construction and no load-balance loss is needed. Returns the
+    same (dispatch [T, E, C] bool, combine [T, E, C] f32, aux) contract as
+    :func:`top_k_routing`; ``fraction_dropped`` reports tokens NO expert
+    picked (they ride the residual unchanged — the scheme's dual trade).
+    """
+    t, e = logits.shape
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)   # [T, E]
+    gates, idx = jax.lax.top_k(probs.T, capacity)                 # [E, C]
+    sel = jax.nn.one_hot(idx, t, dtype=jnp.float32)               # [E, C, T]
+    dispatch = sel.transpose(2, 0, 1) > 0                         # [T, E, C]
+    combine = sel.transpose(2, 0, 1) * gates[None]                # [T, E, C]
+    covered = jnp.clip(jnp.sum(dispatch, axis=(1, 2)), 0, 1)      # [T]
+    aux = {
+        "router_z_loss": jnp.mean(
+            jnp.square(jax.nn.logsumexp(logits.astype(jnp.float32),
+                                        axis=-1))),
+        "fraction_dropped": 1.0 - jnp.mean(covered),
+    }
+    return dispatch, combine, aux
+
+
 class MoEMLP(nn.Module):
     """Expert-parallel SwiGLU MLP with top-k routing.
 
@@ -118,14 +161,23 @@ class MoEMLP(nn.Module):
         e = moe.num_experts
         tokens = x.reshape(b * s, d)
         t = b * s
-        capacity = max(1, int(moe.capacity_factor * moe.top_k * t / e))
+        # Clamp to the token count: capacity_factor*top_k > num_experts
+        # makes the raw capacity exceed T (expert choice's top_k over the
+        # token axis would then be ill-formed; topk slots beyond T can
+        # never fill either).
+        capacity = min(t, max(1, int(moe.capacity_factor * moe.top_k
+                                     * t / e)))
 
         router_w = self.param(
             "router", nn.with_logical_partitioning(default_init(),
                                                    ("embed", "expert")),
             (d, e), jnp.float32)
         logits = tokens.astype(jnp.float32) @ router_w
-        dispatch, combine, aux = top_k_routing(logits, moe.top_k, capacity)
+        if moe.routing == "expert_choice":
+            dispatch, combine, aux = expert_choice_routing(logits, capacity)
+        else:
+            dispatch, combine, aux = top_k_routing(logits, moe.top_k,
+                                                   capacity)
         for name, val in aux.items():
             self.sow("intermediates", name, val)
 
@@ -171,6 +223,23 @@ class MoELM(nn.Module):
             tokens, positions=positions, deterministic=deterministic,
             attention_fn=attention_fn)
         return LMHead(self.cfg, name="head")(x)
+
+
+def flops_per_token(cfg: TransformerConfig, moe: MoEConfig, *,
+                    seq_len: int | None = None) -> float:
+    """Approximate fwd+bwd FLOPs per token for MFU: the dense transformer
+    accounting (:func:`models.transformer.flops_per_token`) with the MLP
+    term scaled by the ACTIVE experts per token — top_k for token-choice
+    routing, capacity_factor·top_k expert-slots/token for expert choice —
+    plus the router matmul. Counts compute actually performed (dispatched
+    slots), not total parameters."""
+    from k8s_distributed_deeplearning_tpu.models import transformer
+    dense = transformer.flops_per_token(cfg, seq_len=seq_len)
+    mlp_term = 3.0 * 3 * 2 * cfg.dim * cfg.resolved_mlp_dim   # swiglu, x3 fwd+bwd
+    active = (moe.capacity_factor * moe.top_k
+              if moe.routing == "expert_choice" else moe.top_k)
+    router = 3.0 * 2 * cfg.dim * moe.num_experts
+    return dense + cfg.n_layers * (mlp_term * (active - 1) + router)
 
 
 def loss_fn(model: MoELM, moe: MoEConfig, params, batch, rng=None):

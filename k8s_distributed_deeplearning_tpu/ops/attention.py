@@ -23,20 +23,25 @@ import jax
 import jax.numpy as jnp
 
 
-def default_impl(seq_len: int, platform: str | None = None) -> str:
+def default_impl(seq_len: int, kv_seq_len: int | None = None,
+                 platform: str | None = None) -> str:
     """Data-driven attention-impl selection (the ``impl="auto"`` rule).
 
     Measured on TPU v5e (BENCHMARKS.md, bench.py --suite attention): the
     Pallas flash kernel beats XLA einsum attention at every tested length —
     S=1024 (1.3x fwd / 1.9x fwd+bwd), S=2048 (1.4x / 2.1x), S=4096
-    (2.1x / 2.2x) — so TPU picks flash whenever the sequence is long enough
-    to tile well (>= 1024, 128-aligned). Off-TPU (CPU CI) flash runs in the
-    Pallas interpreter, which is orders of magnitude slower than XLA: always
-    pick xla there.
+    (2.1x / 2.2x) — so TPU picks flash whenever BOTH sequence lengths tile
+    well (>= 1024, 128-aligned). The measurements are self-attention
+    (sq == sk); a cross-attention caller with an awkward KV length would
+    get degenerate fine blocks (``_pick_block`` can fall to 1), so any
+    badly-tiled side falls back to xla. Off-TPU (CPU CI) flash runs in the
+    Pallas interpreter, orders of magnitude slower than XLA: always xla.
     """
     if platform is None:
         platform = jax.devices()[0].platform
-    if platform in ("tpu", "axon") and seq_len >= 1024 and seq_len % 128 == 0:
+    kv = seq_len if kv_seq_len is None else kv_seq_len
+    well_tiled = all(s >= 1024 and s % 128 == 0 for s in (seq_len, kv))
+    if platform in ("tpu", "axon") and well_tiled:
         return "flash"
     return "xla"
 
@@ -111,7 +116,7 @@ def multi_head_attention(
     ``impl="auto"`` resolves per the measured crossover (:func:`default_impl`).
     """
     if impl == "auto":
-        impl = default_impl(q.shape[1])
+        impl = default_impl(q.shape[1], k.shape[1])
     if impl == "flash" and mask is None:
         from k8s_distributed_deeplearning_tpu.ops import pallas_flash
         return pallas_flash.flash_attention(

@@ -31,9 +31,12 @@ class Checkpointer:
     ``ModelCheckpoint(..., save_best_only=True)`` semantics of the reference's
     Keras variant (``tensorflow_mnist_gpu.py:160-163``): saves carry an eval
     metric via ``save(..., metrics={...})``, and ``max_to_keep`` retains the
-    *best* checkpoints by that metric instead of the newest. Metric-less
-    periodic saves are still accepted (and garbage-collected first), so
-    crash-resume and best-model export coexist in one directory.
+    *best* checkpoints by that metric instead of the newest. The NEWEST
+    checkpoint is additionally always preserved (LatestN + BestN
+    preservation policies), so metric-less periodic saves keep crash-resume
+    recent even after ``max_to_keep`` fills with best-by-metric checkpoints
+    — without the extra slot, a crash after a long eval-free stretch would
+    silently replay from the last *best* step.
     """
 
     def __init__(self, directory: str, max_to_keep: int = 3,
@@ -42,17 +45,27 @@ class Checkpointer:
         self.directory = os.path.abspath(directory)
         self.keep_best_metric = keep_best_metric
         self.async_save = async_save
-        best_kw = {}
         if keep_best_metric is not None:
-            best_kw = dict(
-                best_fn=lambda m: float(m[keep_best_metric]),
-                best_mode=best_mode,
-                keep_checkpoints_without_metrics=False,
-            )
+            from orbax.checkpoint.checkpoint_managers import (
+                preservation_policy as pp)
+            metric_fn = lambda m: float(m[keep_best_metric])
+            options = ocp.CheckpointManagerOptions(
+                preservation_policy=pp.AnyPreservationPolicy(policies=[
+                    pp.LatestN(n=1),        # crash-resume recency slot
+                    pp.BestN(get_metric_fn=metric_fn,
+                             # BestN keeps the tail of an ascending sort;
+                             # reverse flips it for best_mode="min".
+                             reverse=best_mode == "min",
+                             n=max_to_keep,
+                             keep_checkpoints_without_metrics=False),
+                ]),
+                # best_fn/best_mode still drive best_step().
+                best_fn=metric_fn, best_mode=best_mode, create=True)
+        else:
+            options = ocp.CheckpointManagerOptions(max_to_keep=max_to_keep,
+                                                   create=True)
         self._mgr = ocp.CheckpointManager(
-            self.directory,
-            options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep,
-                                                 create=True, **best_kw),
+            self.directory, options=options,
             # Explicit handler so a fresh manager can read item_metadata of an
             # existing checkpoint (restore_params) without a prior save.
             item_handlers=ocp.StandardCheckpointHandler(),
@@ -93,7 +106,16 @@ class Checkpointer:
         ``abstract_state`` is a matching pytree (concrete arrays or
         ShapeDtypeStructs) used to restore with correct shardings.
         """
-        step = self._mgr.latest_step()
+        return self._restore_step(self._mgr.latest_step(), abstract_state)
+
+    def restore_best(self, abstract_state: PyTree) -> tuple[PyTree, int] | None:
+        """Restore the best checkpoint by the tracked metric (best-model
+        export path) — distinct from :meth:`restore_latest`, which serves
+        crash-resume and may be newer than the best."""
+        return self._restore_step(self.best_step(), abstract_state)
+
+    def _restore_step(self, step: int | None,
+                      abstract_state: PyTree) -> tuple[PyTree, int] | None:
         if step is None:
             return None
         ref = jax.tree.map(

@@ -1,0 +1,80 @@
+#!/usr/bin/env bash
+# Full-green proof in bounded chunks (VERDICT r2 item 8).
+#
+# The suite is compile-bound on a 1-core box: one monolithic pytest run
+# exceeds practical tool/CI timeouts, and ad-hoc manual chunking is exactly
+# how a red HEAD slipped through in round 1. This script IS the chunking
+# discipline: it runs the documented chunks sequentially, each under its
+# own timeout, and fails loudly on the first red chunk (or timeout).
+#
+#   tests/run_chunks.sh            # full suite (not-slow chunks, then slow)
+#   tests/run_chunks.sh --fast     # skip the slow chunk (pre-commit loop)
+#
+# Exit code: 0 = every chunk green; nonzero = the failing chunk's status,
+# with the chunk named on stderr. The persistent XLA compile cache
+# (conftest.py) makes warm reruns ~6x faster.
+set -u
+cd "$(dirname "$0")/.."
+
+FAST=0
+[ "${1:-}" = "--fast" ] && FAST=1
+
+# Chunks are groups of test FILES so each stays well under its timeout even
+# cold. Every test file must appear in exactly one chunk — verified below
+# against the tests/ directory listing, so a new file can't silently dodge
+# the runner.
+CHUNK_TIMEOUT="${CHUNK_TIMEOUT:-900}"
+declare -A CHUNKS
+CHUNKS[core]="tests/test_model_mnist.py tests/test_model_zoo.py tests/test_transformer.py tests/test_pallas_flash.py tests/test_bench_gate.py"
+CHUNKS[parallel1]="tests/test_collectives.py tests/test_data_parallel.py tests/test_sharding.py tests/test_8b_scale.py"
+CHUNKS[parallel2]="tests/test_context_parallel.py tests/test_pipeline.py tests/test_pipeline_lm.py tests/test_moe.py"
+CHUNKS[train]="tests/test_grad_accum.py tests/test_chunked_ce.py tests/test_checkpoint.py tests/test_data.py tests/test_prefetch.py tests/test_metrics.py tests/test_profiling.py tests/test_fusion.py"
+CHUNKS[llama]="tests/test_train_llama.py tests/test_generate.py"
+CHUNKS[deploy]="tests/test_render.py tests/test_deploy_smoke.py tests/test_elastic.py tests/test_preemption.py"
+CHUNKS[slow1]="tests/test_train_e2e.py tests/test_multiprocess.py"
+CHUNKS[slow2]="tests/test_multihost_train.py tests/test_multihost_llama.py tests/test_train_zoo.py"
+ORDER=(core parallel1 parallel2 train llama deploy slow1 slow2)
+
+# --- completeness check: every tests/test_*.py is in some chunk ----------
+listed=$(echo "${CHUNKS[@]}" | tr ' ' '\n' | sort)
+actual=$(ls tests/test_*.py | sort)
+missing=$(comm -23 <(echo "$actual") <(echo "$listed"))
+if [ -n "$missing" ]; then
+    echo "run_chunks.sh: test files not assigned to any chunk:" >&2
+    echo "$missing" >&2
+    exit 3
+fi
+
+# Two passes over EVERY chunk: fast tests first (-m "not slow"), then —
+# unless --fast — the slow-marked tests of the same files. Slow tests live
+# in many files (8B compile checks, CLI e2e, long-context CP), so scoping
+# the slow pass to designated "slow files" would silently skip the rest.
+run_chunk() {  # $1 = chunk name, $2 = marker expression, $3 = label
+    echo "=== chunk: $3 ==="
+    timeout "$CHUNK_TIMEOUT" python -m pytest ${CHUNKS[$1]} -q -m "$2"
+    rc=$?
+    [ $rc -eq 5 ] && rc=0   # pytest 5 = no tests matched the marker: fine
+    if [ $rc -ne 0 ]; then
+        if [ $rc -ge 124 ]; then
+            echo "run_chunks.sh: chunk '$3' TIMED OUT (${CHUNK_TIMEOUT}s)" >&2
+        else
+            echo "run_chunks.sh: chunk '$3' FAILED (rc=$rc)" >&2
+        fi
+    fi
+    return $rc
+}
+
+overall=0
+for name in "${ORDER[@]}"; do
+    run_chunk "$name" "not slow" "$name" || { overall=$?; break; }
+done
+if [ $overall -eq 0 ] && [ "$FAST" != 1 ]; then
+    for name in "${ORDER[@]}"; do
+        run_chunk "$name" "slow" "$name (slow)" || { overall=$?; break; }
+    done
+fi
+
+if [ $overall -eq 0 ]; then
+    echo "run_chunks.sh: all chunks green$([ "$FAST" = 1 ] && echo ' (fast mode: slow chunks skipped)')"
+fi
+exit $overall

@@ -46,13 +46,14 @@ def test_best_checkpoint_survives_max_to_keep(tmp_path):
     for s, acc in history:
         ckpt.save(s, _state(float(s)), metrics={"accuracy": acc})
     assert ckpt.best_step() == 3
-    # Retained set = the 2 best by accuracy: steps 3 (.95) and 2 (.80).
+    # Retained set = the 2 best by accuracy — steps 3 (.95) and 2 (.80) —
+    # plus the newest save (crash-resume recency slot, round 3).
     kept = {int(p.name) for p in (tmp_path / "ck").iterdir()
             if p.name.isdigit()}
-    assert kept == {2, 3}
+    assert kept == {2, 3, 6}
     restored, step = ckpt.restore_latest(_state(0.0))
-    assert step == 3  # newest surviving == best here
-    np.testing.assert_allclose(restored["params"]["w"], np.full((3, 2), 3.0))
+    assert step == 6
+    np.testing.assert_allclose(restored["params"]["w"], np.full((3, 2), 6.0))
     ckpt.close()
 
 
@@ -103,9 +104,14 @@ def test_fit_eval_hook_feeds_best_checkpointing(tmp_path):
              metrics=Rec(), checkpointer=ckpt, checkpoint_every=0,
              log_every=0, eval_every=1, eval_fn=eval_fn)
     assert ckpt.best_step() == 3
-    restored, step = ckpt.restore_latest(
+    # Best-model export restores the metric peak; crash-resume
+    # (restore_latest) gets the newest state — both retained (round 3).
+    restored, step = ckpt.restore_best(
         {"w": jnp.float32(0.0), "step": jnp.int32(0)})
     assert step == 3 and float(restored["w"]) == 3.0
+    latest, lstep = ckpt.restore_latest(
+        {"w": jnp.float32(0.0), "step": jnp.int32(0)})
+    assert lstep == 6 and float(latest["w"]) == 6.0
     evals = [kw for e, kw in events if e == "eval"]
     assert len(evals) == 6 and evals[2]["accuracy"] == 0.0
     ckpt.close()
@@ -214,4 +220,28 @@ def test_async_save_roundtrip(tmp_path):
     assert step == 2
     np.testing.assert_allclose(restored["params"]["w"],
                                np.full((64, 64), 2.0))
+    ckpt.close()
+
+
+def test_keep_best_preserves_latest_for_crash_resume(tmp_path):
+    """ADVICE r2: with keep_best retention, a metric-less periodic save
+    newer than every best checkpoint must survive GC — otherwise a crash
+    after a long eval-free stretch resumes from the last *best* step and
+    silently replays training."""
+    ckpt = Checkpointer(str(tmp_path / "ck"), max_to_keep=2,
+                        keep_best_metric="accuracy", best_mode="max")
+    for s, acc in [(1, 0.5), (2, 0.8), (3, 0.95)]:
+        ckpt.save(s, _state(float(s)), metrics={"accuracy": acc})
+    # max_to_keep is now full of best checkpoints {2, 3}; periodic saves
+    # follow with no eval in between.
+    ckpt.save(10, _state(10.0))
+    ckpt.save(20, _state(20.0))
+    assert ckpt.best_step() == 3
+    assert ckpt.latest_step() == 20          # NOT collected
+    restored, step = ckpt.restore_latest(_state(0.0))
+    assert step == 20
+    np.testing.assert_allclose(restored["params"]["w"], np.full((3, 2), 20.0))
+    kept = {int(p.name) for p in (tmp_path / "ck").iterdir()
+            if p.name.isdigit()}
+    assert kept == {2, 3, 20}   # best two + the latest; step 10 collected
     ckpt.close()

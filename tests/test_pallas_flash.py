@@ -183,6 +183,11 @@ def test_default_impl_rule():
     assert default_impl(1100, platform="tpu") == "xla"      # not 128-aligned
     assert default_impl(4096, platform="cpu") == "xla"      # interpret mode
     assert default_impl(4096) == "xla"                      # CI runs on CPU
+    # Cross-attention: BOTH lengths must tile well (ADVICE r2 item 4).
+    assert default_impl(2048, 2048, platform="tpu") == "flash"
+    assert default_impl(2048, 1100, platform="tpu") == "xla"
+    assert default_impl(2048, 512, platform="tpu") == "xla"
+    assert default_impl(512, 4096, platform="tpu") == "xla"
 
 
 def test_auto_impl_dispatches_and_matches():

@@ -100,10 +100,12 @@ def test_train_llama_packed_cli(tmp_path):
 
 
 def test_train_llama_pack_flag_conflicts():
+    # --pack + --pp is supported since round 3 (packed pipeline path);
+    # context-parallel attention remains the conflicting combination.
     import train_llama
     with pytest.raises(ValueError, match="--pack"):
-        train_llama.main(["--preset", "tiny", "--pack", "--pp", "2",
-                          "--num-steps", "1"])
+        train_llama.main(["--preset", "tiny", "--pack", "--sp", "2",
+                          "--attention", "ring", "--num-steps", "1"])
 
 
 def test_train_llama_pp_flag_conflicts():
