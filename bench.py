@@ -301,8 +301,11 @@ def measure_moe(steps: int = 12, warmup: int = 3) -> dict:
 def measure_decode(batch: int = 8, prompt_len: int = 128,
                    new_tokens: int = 128, repeats: int = 3) -> dict:
     """Autoregressive decode tokens/sec on the Llama-small config through
-    generate() (windowed KV cache + jitted scan loop); the number behind
-    BENCHMARKS.md's decode table."""
+    generate() (windowed KV cache + jitted scan loop); the numbers behind
+    BENCHMARKS.md's decode table. Covers the serving shapes: the baseline
+    batch, a large batch (throughput scaling), and a LEFT-PADDED
+    unequal-length batch (the batched-serving path, round 3) — each timed
+    over multiple prompt rounds reusing one compiled program."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -317,24 +320,43 @@ def measure_decode(batch: int = 8, prompt_len: int = 128,
     model = llama.LlamaLM(cfg)
     params = model.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))[
         "params"]
-    prompt = jax.random.randint(jax.random.key(1), (batch, prompt_len), 0,
-                                cfg.vocab_size, dtype=jnp.int32)
 
-    def run():
-        return np.asarray(gen.generate(model, params, prompt,
-                                       max_new_tokens=new_tokens))
+    def timed(run, n_tokens):
+        run()  # compile
+        runs = []
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            run()  # np.asarray inside = value fetch (honest sync)
+            runs.append(n_tokens / (time.perf_counter() - t0))
+        return round(sorted(runs)[len(runs) // 2], 1)
 
-    run()  # compile
-    runs = []
-    for _ in range(repeats):
-        t0 = time.perf_counter()
-        run()  # np.asarray = value fetch (honest sync)
-        runs.append(batch * new_tokens / (time.perf_counter() - t0))
-    tps = sorted(runs)[len(runs) // 2]
-    return {"decode_tokens_per_sec": round(tps, 1),
-            "decode_config": {"params_m": 124, "batch": batch,
-                              "prompt": prompt_len, "new": new_tokens,
-                              "kv_window": "auto (128-aligned)"}}
+    out: dict = {"decode_config": {"params_m": 124, "prompt": prompt_len,
+                                   "new": new_tokens,
+                                   "kv_window": "auto (128-aligned)"}}
+    for b in (batch, 4 * batch):
+        prompt = jax.random.randint(jax.random.key(1), (b, prompt_len), 0,
+                                    cfg.vocab_size, dtype=jnp.int32)
+        run = lambda: np.asarray(gen.generate(model, params, prompt,
+                                              max_new_tokens=new_tokens))
+        key = ("decode_tokens_per_sec" if b == batch
+               else f"decode_b{b}_tokens_per_sec")
+        out[key] = timed(run, b * new_tokens)
+
+    # Left-padded unequal-length batch (batched serving): same compiled
+    # program as equal-length decode plus the validity mask.
+    lens = np.asarray([prompt_len - (i * 16) % 96 for i in range(batch)])
+    pm = np.zeros((batch, prompt_len), np.int32)
+    toks = np.zeros((batch, prompt_len), np.int32)
+    rng = np.random.default_rng(0)
+    for i, L in enumerate(lens):
+        pm[i, prompt_len - L:] = 1
+        toks[i, prompt_len - L:] = rng.integers(0, cfg.vocab_size, size=L)
+    toks_j, pm_j = jnp.asarray(toks), jnp.asarray(pm)
+    run = lambda: np.asarray(gen.generate(model, params, toks_j,
+                                          max_new_tokens=new_tokens,
+                                          prompt_mask=pm_j))
+    out["decode_padded_tokens_per_sec"] = timed(run, batch * new_tokens)
+    return out
 
 
 def measure_attention(seq_lens=(1024, 2048, 4096), steps: int = 20,

@@ -64,7 +64,8 @@ def generate(model, params: PyTree, prompt: jax.Array, *,
              max_new_tokens: int, rng: jax.Array | None = None,
              temperature: float = 0.0, top_k: int | None = None,
              top_p: float | None = None, eos_id: int | None = None,
-             pad_id: int = 0) -> jax.Array:
+             pad_id: int = 0,
+             prompt_mask: jax.Array | None = None) -> jax.Array:
     """Generate ``max_new_tokens`` continuations of ``prompt`` ([B, S] int32).
 
     ``temperature=0`` is greedy argmax; otherwise categorical sampling with
@@ -74,6 +75,13 @@ def generate(model, params: PyTree, prompt: jax.Array, *,
     ``max_seq_len``. Only the greedy/sampling CHOICE is compile-time; the
     temperature value itself is a traced operand, so sweeping temperatures
     reuses one compiled program.
+
+    ``prompt_mask`` ([B, S], 0/False = padding) enables batching prompts of
+    UNEQUAL lengths: pad each prompt at the FRONT (left-padding, so every
+    row's last real token sits at column S-1 where the first sampled token
+    reads its logits), and pass the validity mask. Pad positions are
+    excluded from attention and RoPE positions count real tokens only, so
+    each row decodes exactly as it would unpadded (parity-tested).
     """
     if temperature > 0.0 and rng is None:
         raise ValueError("temperature sampling requires rng")
@@ -112,9 +120,21 @@ def generate(model, params: PyTree, prompt: jax.Array, *,
             # Module.clone keeps every other field (e.g. MoE configs).
             model = model.clone(cfg=dataclasses.replace(
                 cfg, max_seq_len=window))
+    if prompt_mask is not None:
+        if prompt_mask.shape != prompt.shape:
+            raise ValueError(f"prompt_mask {prompt_mask.shape} must match "
+                             f"prompt {prompt.shape}")
+        import numpy as np
+        pm = np.asarray(prompt_mask).astype(bool)
+        if not (pm[:, -1].all() and
+                (np.diff(pm.astype(np.int8), axis=1) >= 0).all()):
+            raise ValueError(
+                "prompt_mask must be LEFT-padded: zeros before ones, last "
+                "column all-real (each row's final token is where decoding "
+                "starts)")
     rng = jax.random.key(0) if rng is None else rng
     return _generate(model, params, prompt, jnp.float32(temperature), rng,
-                     greedy=temperature <= 0.0,
+                     prompt_mask, greedy=temperature <= 0.0,
                      max_new_tokens=max_new_tokens, eos_id=eos_id,
                      pad_id=pad_id, top_k=top_k, top_p=top_p)
 
@@ -123,13 +143,28 @@ def generate(model, params: PyTree, prompt: jax.Array, *,
                                              "max_new_tokens", "eos_id",
                                              "pad_id", "top_k", "top_p"))
 def _generate(model, params: PyTree, prompt: jax.Array,
-              temperature: jax.Array, rng: jax.Array, *, greedy: bool,
+              temperature: jax.Array, rng: jax.Array,
+              prompt_mask: jax.Array | None = None, *, greedy: bool,
               max_new_tokens: int, eos_id: int | None,
               pad_id: int, top_k: int | None = None,
               top_p: float | None = None) -> jax.Array:
+    b, s = prompt.shape
+    prefill_kw: dict = {}
+    lens = None
+    if prompt_mask is not None:
+        # Left-padded batch: RoPE positions count REAL tokens (pads don't
+        # advance a row's position), and the mask rides into the cache as
+        # per-position validity (models/transformer.py decode branch).
+        ok = (prompt_mask != 0).astype(jnp.int32)
+        lens = ok.sum(-1).astype(jnp.int32)                    # [B]
+        start = s - lens
+        prefill_kw = dict(
+            positions=jnp.clip(jnp.arange(s)[None, :] - start[:, None],
+                               0, None),
+            segment_ids=ok)
     # Prefill: run the prompt through decode mode, filling the cache.
     logits, vars_ = model.apply({"params": params}, prompt, decode=True,
-                                mutable=["cache"])
+                                mutable=["cache"], **prefill_kw)
     cache = vars_["cache"]
 
     def sample(logits_last, step_rng):
@@ -146,18 +181,28 @@ def _generate(model, params: PyTree, prompt: jax.Array,
     alive0 = (first != eos_id if eos_id is not None
               else jnp.ones_like(first, jnp.bool_))
 
-    def body(carry, step_rng):
+    def body(carry, xs):
         cache, token, alive = carry
+        step_rng, t = xs
+        step_kw = {}
+        if lens is not None:
+            # Decode token t sits at real position lens + t per row; the
+            # step keeps passing segment ids (all real) so the cache's
+            # pad-validity mask stays active (static-presence contract,
+            # models/transformer.py decode branch).
+            step_kw["positions"] = (lens + t)[:, None]
+            step_kw["segment_ids"] = jnp.ones((b, 1), jnp.int32)
         logits, vars_ = model.apply({"params": params, "cache": cache},
                                     token[:, None], decode=True,
-                                    mutable=["cache"])
+                                    mutable=["cache"], **step_kw)
         nxt = sample(logits[:, -1, :], step_rng).astype(jnp.int32)
         if eos_id is not None:
             nxt = jnp.where(alive, nxt, pad_id)
             alive = alive & (nxt != eos_id)
         return (vars_["cache"], nxt, alive), nxt
 
-    steps = jax.random.split(rng, max(max_new_tokens - 1, 0))
+    n_rest = max(max_new_tokens - 1, 0)
+    steps = (jax.random.split(rng, n_rest), jnp.arange(n_rest))
     (_, _, _), rest = jax.lax.scan(body, (cache, first, alive0), steps)
     out = jnp.concatenate([first[:, None], rest.T], axis=1)
     return out

@@ -233,23 +233,39 @@ class Attention(nn.Module):
                             name="v_proj")(x)
         cur = None
         if decode:
-            if mask is not None or attention_fn is not None \
-                    or segment_ids is not None:
+            if mask is not None or attention_fn is not None:
                 raise NotImplementedError(
                     "decode mode builds its own cache-prefix mask and local "
-                    "attention; caller-provided mask/attention_fn would be "
-                    "silently wrong — left-pad-free prompts only for now")
+                    "attention; a caller-provided mask/attention_fn would be "
+                    "silently wrong")
             b, sq = x.shape[0], x.shape[1]
             kv = cfg.resolved_kv_heads
             cached_k = self.variable("cache", "cached_key", jnp.zeros,
                                      (b, cfg.max_seq_len, kv, hd), cfg.dtype)
             cached_v = self.variable("cache", "cached_value", jnp.zeros,
                                      (b, cfg.max_seq_len, kv, hd), cfg.dtype)
+            # Per-position document ids, same contract as training: decode
+            # queries attend only cache entries with THEIR document id.
+            # id 0 marks left-padding (batched serving pads unequal prompts
+            # at the FRONT); pad K/V enter the cache but are never attended.
+            # The STATIC presence of segment_ids selects the masked variant
+            # — plain decode pays nothing — so a caller that prefills with
+            # segment_ids must pass them on every decode step too (the
+            # padded/packed generate paths do).
+            use_seg = segment_ids is not None
+            cached_seg = self.variable("cache", "cached_seg", jnp.ones,
+                                       (b, cfg.max_seq_len), jnp.int32)
             cache_index = self.variable("cache", "cache_index",
                                         lambda: jnp.zeros((), jnp.int32))
             cur = cache_index.value
+            if use_seg:
+                seg_now = segment_ids.astype(jnp.int32)
+                cached_seg.value = jax.lax.dynamic_update_slice(
+                    cached_seg.value, seg_now, (0, cur))
+            segment_ids = None     # consumed into the cache mask below
             if positions is None:
                 # Absolute positions for RoPE: the cache cursor onward.
+                # (Left-padded callers pass explicit per-row positions.)
                 positions = (cur + jnp.arange(sq))[None, :]
 
         if cfg.position == "rope":
@@ -269,7 +285,25 @@ class Attention(nn.Module):
             cache_index.value = cur + sq
             col = jnp.arange(cfg.max_seq_len)
             row_pos = cur + jnp.arange(sq)
-            dmask = (col[None, :] <= row_pos[:, None])[None, None]  # [1,1,sq,Smax]
+            base = (col[None, :] <= row_pos[:, None])[None, None]  # [1,1,sq,Smax]
+            diag = (col[None, :] == row_pos[:, None])[None, None]
+            if use_seg:
+                # Same-document columns only (pads are id 0, never any
+                # query's id); `col == row` keeps the query's own slot so
+                # even an all-pad row has one finite score (no NaN softmax
+                # — pad-row outputs are garbage but never attended by real
+                # rows).
+                same = (cached_seg.value[:, None, None, :]
+                        == seg_now[:, None, :, None])              # [B,1,sq,Smax]
+                dmask = (base & same) | diag
+            else:
+                # Safety net for a caller that prefilled WITH segment ids
+                # but stepped without them: pad entries (id 0) stay
+                # invisible (full per-document isolation still needs the
+                # ids passed every step). All-ones cache => no-op mask;
+                # measured within decode run-to-run noise.
+                ok = cached_seg.value[:, None, None, :] != 0
+                dmask = (base & ok) | diag
             out = attention_ops.multi_head_attention(
                 q, k_all, v_all, causal=False, mask=dmask, impl="xla")
         else:

@@ -35,13 +35,19 @@ CHUNKS[slow1]="tests/test_train_e2e.py tests/test_multiprocess.py"
 CHUNKS[slow2]="tests/test_multihost_train.py tests/test_multihost_llama.py tests/test_train_zoo.py"
 ORDER=(core parallel1 parallel2 train llama deploy slow1 slow2)
 
-# --- completeness check: every tests/test_*.py is in some chunk ----------
+# --- completeness check: every tests/test_*.py in EXACTLY one chunk ------
 listed=$(echo "${CHUNKS[@]}" | tr ' ' '\n' | sort)
 actual=$(ls tests/test_*.py | sort)
 missing=$(comm -23 <(echo "$actual") <(echo "$listed"))
 if [ -n "$missing" ]; then
     echo "run_chunks.sh: test files not assigned to any chunk:" >&2
     echo "$missing" >&2
+    exit 3
+fi
+dupes=$(echo "$listed" | uniq -d)
+if [ -n "$dupes" ]; then
+    echo "run_chunks.sh: test files assigned to MULTIPLE chunks (would run twice):" >&2
+    echo "$dupes" >&2
     exit 3
 fi
 
@@ -55,8 +61,10 @@ run_chunk() {  # $1 = chunk name, $2 = marker expression, $3 = label
     rc=$?
     [ $rc -eq 5 ] && rc=0   # pytest 5 = no tests matched the marker: fine
     if [ $rc -ne 0 ]; then
-        if [ $rc -ge 124 ]; then
+        if [ $rc -eq 124 ]; then
             echo "run_chunks.sh: chunk '$3' TIMED OUT (${CHUNK_TIMEOUT}s)" >&2
+        elif [ $rc -gt 128 ]; then
+            echo "run_chunks.sh: chunk '$3' KILLED by signal $((rc - 128))" >&2
         else
             echo "run_chunks.sh: chunk '$3' FAILED (rc=$rc)" >&2
         fi

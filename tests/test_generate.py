@@ -182,3 +182,108 @@ def test_generate_rejects_bad_top_params(tiny_model):
     with pytest.raises(ValueError, match="top_k"):
         generate.generate(model, params, prompt, max_new_tokens=2,
                           temperature=1.0, top_k=0, rng=jax.random.key(0))
+
+
+def test_left_padded_batch_matches_unpadded_rows():
+    """Left-padded batched decode (round 3): each row of a padded batch with
+    UNEQUAL prompt lengths must generate exactly what it generates alone,
+    unpadded — the batched-serving parity property (pad positions out of
+    attention, RoPE counting real tokens only)."""
+    model, params, tokens, cfg = _model()
+    lens = [12, 7, 3]
+    s = max(lens)
+    rows, mask = [], []
+    rng = np.random.default_rng(0)
+    for i, L in enumerate(lens):
+        real = rng.integers(0, cfg.vocab_size, size=(L,), dtype=np.int64)
+        rows.append(np.concatenate([np.zeros(s - L, np.int64), real]))
+        mask.append(np.concatenate([np.zeros(s - L, np.int64),
+                                    np.ones(L, np.int64)]))
+        # Unpadded single-row reference.
+        ref = generate.generate(model, params,
+                                jnp.asarray(real)[None, :],
+                                max_new_tokens=6)
+        rows[-1] = (rows[-1], np.asarray(ref)[0])
+    batch = jnp.asarray(np.stack([r for r, _ in rows]))
+    pmask = jnp.asarray(np.stack(mask))
+    out = generate.generate(model, params, batch, max_new_tokens=6,
+                            prompt_mask=pmask)
+    for i, (_, ref) in enumerate(rows):
+        np.testing.assert_array_equal(np.asarray(out)[i], ref,
+                                      err_msg=f"row {i} (len {lens[i]})")
+
+
+def test_left_padding_validation():
+    model, params, tokens, _ = _model()
+    bad = jnp.asarray([[1, 1, 0, 1]])   # right padding / hole
+    with pytest.raises(ValueError, match="LEFT-padded"):
+        generate.generate(model, params, tokens[:1, :4], max_new_tokens=2,
+                          prompt_mask=bad)
+    with pytest.raises(ValueError, match="must match"):
+        generate.generate(model, params, tokens[:1, :4], max_new_tokens=2,
+                          prompt_mask=jnp.ones((1, 5)))
+
+
+def test_tp_sharded_decode_matches_unsharded():
+    """TP decode (round 3): generation with params sharded Megatron-style
+    over a tensor axis must match unsharded generation token-for-token
+    (XLA propagates the head sharding through the KV cache)."""
+    import flax.linen as nn
+    from k8s_distributed_deeplearning_tpu.parallel import (
+        mesh as mesh_lib, sharding)
+
+    model, params, tokens, cfg = _model()
+    ref = generate.generate(model, params, tokens, max_new_tokens=8)
+
+    mesh = mesh_lib.make_mesh({"data": 4, "tensor": 2})
+    boxed = model.init(jax.random.key(1),
+                       jnp.zeros((1, 8), jnp.int32))["params"]
+    shardings = nn.logical_to_mesh_sharding(
+        nn.get_partition_spec(boxed), mesh, sharding.resolve_rules(mesh))
+    params_tp = jax.device_put(nn.meta.unbox(params), shardings)
+    # Sanity: attention heads really are sharded over the tensor axis.
+    qk = params_tp["transformer"]["blocks"]["attn"]["q_proj"]["kernel"]
+    assert "tensor" in jax.tree.leaves(
+        [ax for ax in qk.sharding.spec if ax is not None]) or \
+        not qk.sharding.is_fully_replicated
+    out = generate.generate(model, params_tp, tokens, max_new_tokens=8)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_packed_decode_isolates_documents():
+    """Decode-mode segment ids honor document isolation (round 3): a packed
+    row [doc1 | doc2] prefilled with segment ids, then decoded as a doc-2
+    continuation, must produce exactly the logits of decoding doc2 alone —
+    doc1's cached K/V is invisible across the boundary."""
+    from k8s_distributed_deeplearning_tpu.models import transformer as tfm
+
+    model, params, _, cfg = _model()
+    rng = np.random.default_rng(3)
+    d1 = rng.integers(0, cfg.vocab_size, size=(1, 5), dtype=np.int64)
+    d2 = rng.integers(0, cfg.vocab_size, size=(1, 4), dtype=np.int64)
+    packed = jnp.asarray(np.concatenate([d1, d2], axis=1))
+    seg = jnp.asarray([[1] * 5 + [2] * 4])
+    pos = tfm.packed_positions(seg)
+
+    # Packed prefill, then one decode step continuing doc 2.
+    logits_p, vars_p = model.apply({"params": params}, packed, decode=True,
+                                   segment_ids=seg, positions=pos,
+                                   mutable=["cache"])
+    nxt = jnp.argmax(logits_p[:, -1:], axis=-1).astype(jnp.int32)
+    step_p, _ = model.apply({"params": params, "cache": vars_p["cache"]},
+                            nxt, decode=True,
+                            segment_ids=jnp.full((1, 1), 2),
+                            positions=jnp.full((1, 1), d2.shape[1]),
+                            mutable=["cache"])
+
+    # Reference: doc 2 alone.
+    logits_r, vars_r = model.apply({"params": params}, jnp.asarray(d2),
+                                   decode=True, mutable=["cache"])
+    np.testing.assert_allclose(np.asarray(logits_p[:, 5:]),
+                               np.asarray(logits_r), atol=2e-5, rtol=2e-5)
+    nxt_r = jnp.argmax(logits_r[:, -1:], axis=-1).astype(jnp.int32)
+    np.testing.assert_array_equal(np.asarray(nxt), np.asarray(nxt_r))
+    step_r, _ = model.apply({"params": params, "cache": vars_r["cache"]},
+                            nxt_r, decode=True, mutable=["cache"])
+    np.testing.assert_allclose(np.asarray(step_p), np.asarray(step_r),
+                               atol=2e-5, rtol=2e-5)
