@@ -124,14 +124,18 @@ def generate(model, params: PyTree, prompt: jax.Array, *,
         if prompt_mask.shape != prompt.shape:
             raise ValueError(f"prompt_mask {prompt_mask.shape} must match "
                              f"prompt {prompt.shape}")
-        import numpy as np
-        pm = np.asarray(prompt_mask).astype(bool)
-        if not (pm[:, -1].all() and
-                (np.diff(pm.astype(np.int8), axis=1) >= 0).all()):
-            raise ValueError(
-                "prompt_mask must be LEFT-padded: zeros before ones, last "
-                "column all-real (each row's final token is where decoding "
-                "starts)")
+        if not isinstance(prompt_mask, jax.core.Tracer):
+            # Value check only on concrete masks — under an outer jit/vmap
+            # the caller owns the left-padding contract (a tracer here
+            # would otherwise force a device sync or a trace error).
+            import numpy as np
+            pm = np.asarray(prompt_mask).astype(bool)
+            if not (pm[:, -1].all() and
+                    (np.diff(pm.astype(np.int8), axis=1) >= 0).all()):
+                raise ValueError(
+                    "prompt_mask must be LEFT-padded: zeros before ones, "
+                    "last column all-real (each row's final token is where "
+                    "decoding starts)")
     rng = jax.random.key(0) if rng is None else rng
     return _generate(model, params, prompt, jnp.float32(temperature), rng,
                      prompt_mask, greedy=temperature <= 0.0,
