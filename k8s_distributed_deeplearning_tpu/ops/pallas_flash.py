@@ -79,6 +79,29 @@ def _superblock(s: int) -> int:
     return _pick_block(s, _SUPERBLOCK)
 
 
+
+def _diag_split(causal: bool, off: int, resident: bool, segments: bool,
+                block_q: int, block_k: int) -> bool:
+    """Static predicate for the diagonal-split causal specialization (the
+    flagship self-attention shape): with square blocks and aligned
+    diagonals, EVERY fine block is either fully visible (no mask work) or
+    THE diagonal block, whose mask is one fixed triangle ADDED as a bias —
+    computed once per grid cell instead of two iotas + compare + select per
+    block. The kernels are VPU-bound, so dropping those per-block passes is
+    the win (BENCHMARKS.md round 3)."""
+    return (causal and off == 0 and resident and not segments
+            and block_q == block_k)
+
+
+def _causal_tri(block_q: int, block_k: int) -> jax.Array:
+    """The [block_q, block_k] lower-triangle additive bias (0 on/below the
+    diagonal, NEG_INF above) for the diagonal block."""
+    return jnp.where(
+        jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+        >= jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1),
+        0.0, NEG_INF)
+
+
 # ---------------------------------------------------------------- forward
 
 def _fwd_kernel(q_ref, k_ref, v_ref, *rest,
@@ -117,41 +140,48 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest,
                             0, sb // block_k)
         return sb // block_k
 
-    def body(j, carry):
-        m, l, acc = carry
-        k = k_ref[0, pl.ds(j * block_k, block_k), :]
-        v = v_ref[0, pl.ds(j * block_k, block_k), :]
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
-        if causal:
-            row = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, s.shape, 0)
-            col = base + j * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, s.shape, 1)
-            s = jnp.where(row + off >= col, s, NEG_INF)
-        if segments:
-            sq_ids = segq_ref[0, 0]                               # [bq]
-            sk_ids = segk_ref[0, 0, pl.ds(j * block_k, block_k)]
-            s = jnp.where(sq_ids[:, None] == sk_ids[None, :], s, NEG_INF)
-        bm = jnp.max(s, axis=-1)
-        m_new = jnp.maximum(m, bm)
-        p = jnp.exp(s - m_new[:, None])
-        if segments or off < 0:
-            # A fully-masked row has m == NEG_INF and would exp(0) = 1;
-            # zero it. Possible under segment masks, and under causal with
-            # sq > sk (off < 0: leading rows see no columns). In the common
-            # causal sk >= sq case every row sees at least column 0, so
-            # masked entries underflow to exactly 0 on their own — skip the
-            # pass.
-            p = jnp.where(s <= NEG_INF / 2, 0.0, p)
-        alpha = jnp.exp(m - m_new)
-        l_new = alpha * l + jnp.sum(p, axis=-1)
-        # P rides the MXU in the storage dtype too — the same trade the XLA
-        # path makes (probs.astype(v.dtype) before the PV matmul).
-        acc_new = alpha[:, None] * acc + jax.lax.dot_general(
-            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        return m_new, l_new, acc_new
+    diag_split = _diag_split(causal, off, resident, segments,
+                             block_q, block_k)
+
+    def make_body(general_mask: bool, bias):
+        def body(j, carry):
+            m, l, acc = carry
+            k = k_ref[0, pl.ds(j * block_k, block_k), :]
+            v = v_ref[0, pl.ds(j * block_k, block_k), :]
+            s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                    preferred_element_type=jnp.float32) * scale
+            if bias is not None:
+                s = s + bias
+            if general_mask:
+                row = qi * block_q + jax.lax.broadcasted_iota(
+                    jnp.int32, s.shape, 0)
+                col = base + j * block_k + jax.lax.broadcasted_iota(
+                    jnp.int32, s.shape, 1)
+                s = jnp.where(row + off >= col, s, NEG_INF)
+            if segments:
+                sq_ids = segq_ref[0, 0]                           # [bq]
+                sk_ids = segk_ref[0, 0, pl.ds(j * block_k, block_k)]
+                s = jnp.where(sq_ids[:, None] == sk_ids[None, :], s, NEG_INF)
+            bm = jnp.max(s, axis=-1)
+            m_new = jnp.maximum(m, bm)
+            p = jnp.exp(s - m_new[:, None])
+            if segments or off < 0:
+                # A fully-masked row has m == NEG_INF and would exp(0) = 1;
+                # zero it. Possible under segment masks, and under causal
+                # with sq > sk (off < 0: leading rows see no columns). In
+                # the common causal sk >= sq case every row sees at least
+                # column 0, so masked entries underflow to exactly 0 on
+                # their own — skip the pass.
+                p = jnp.where(s <= NEG_INF / 2, 0.0, p)
+            alpha = jnp.exp(m - m_new)
+            l_new = alpha * l + jnp.sum(p, axis=-1)
+            # P rides the MXU in the storage dtype too — the same trade the
+            # XLA path makes (probs.astype(v.dtype) before the PV matmul).
+            acc_new = alpha[:, None] * acc + jax.lax.dot_general(
+                p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            return m_new, l_new, acc_new
+        return body
 
     def emit(m, l, acc):
         norm = jnp.maximum(l, 1e-30)
@@ -162,11 +192,16 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest,
         # Fast path (statically selected): carries live in registers, no
         # scratch traffic, no grid predicates — identical to a single-pass
         # whole-KV kernel.
-        m, l, acc = jax.lax.fori_loop(
-            0, n_inner(),
-            body, (jnp.full((block_q,), NEG_INF, jnp.float32),
-                   jnp.zeros((block_q,), jnp.float32),
-                   jnp.zeros((block_q, q.shape[-1]), jnp.float32)))
+        init = (jnp.full((block_q,), NEG_INF, jnp.float32),
+                jnp.zeros((block_q,), jnp.float32),
+                jnp.zeros((block_q, q.shape[-1]), jnp.float32))
+        if diag_split:
+            tri = _causal_tri(block_q, block_k)
+            carry = jax.lax.fori_loop(0, qi, make_body(False, None), init)
+            m, l, acc = make_body(False, tri)(qi, carry)
+        else:
+            m, l, acc = jax.lax.fori_loop(0, n_inner(),
+                                          make_body(causal, None), init)
         emit(m, l, acc)
         return
 
@@ -181,7 +216,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest,
     @pl.when(run)
     def _superblock_body():
         m, l, acc = jax.lax.fori_loop(
-            0, n_inner(), body, (m_s[...], l_s[...], acc_s[...]))
+            0, n_inner(), make_body(causal, None),
+            (m_s[...], l_s[...], acc_s[...]))
         m_s[...], l_s[...], acc_s[...] = m, l, acc
 
     @pl.when(kb == n_sb - 1)
@@ -298,35 +334,48 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
                             0, sb // block_k)
         return sb // block_k
 
-    def body(j, dq):
-        k = k_ref[0, pl.ds(j * block_k, block_k), :]
-        v = v_ref[0, pl.ds(j * block_k, block_k), :]
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
-        if causal:
-            row = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, s.shape, 0)
-            col = base + j * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, s.shape, 1)
-            s = jnp.where(row + off >= col, s, NEG_INF)
-        if segments:
-            sq_ids = segq_ref[0, 0]
-            sk_ids = segk_ref[0, 0, pl.ds(j * block_k, block_k)]
-            s = jnp.where(sq_ids[:, None] == sk_ids[None, :], s, NEG_INF)
-        p = jnp.exp(s - lse[:, None])
-        if segments or off < 0:
-            # Fully-masked rows (segment masks, or causal sq > sk — see
-            # _fwd_kernel) have a degenerate lse; force exact zeros.
-            p = jnp.where(s <= NEG_INF / 2, 0.0, p)
-        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
-        ds = (p * (dp - delta[:, None]) * scale).astype(k.dtype)
-        return dq + jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
-                                        preferred_element_type=jnp.float32)
+    diag_split = _diag_split(causal, off, resident, segments,
+                             block_q, block_k)
+
+    def make_body(general_mask: bool, bias):
+        def body(j, dq):
+            k = k_ref[0, pl.ds(j * block_k, block_k), :]
+            v = v_ref[0, pl.ds(j * block_k, block_k), :]
+            s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                    preferred_element_type=jnp.float32) * scale
+            if bias is not None:
+                s = s + bias
+            if general_mask:
+                row = qi * block_q + jax.lax.broadcasted_iota(
+                    jnp.int32, s.shape, 0)
+                col = base + j * block_k + jax.lax.broadcasted_iota(
+                    jnp.int32, s.shape, 1)
+                s = jnp.where(row + off >= col, s, NEG_INF)
+            if segments:
+                sq_ids = segq_ref[0, 0]
+                sk_ids = segk_ref[0, 0, pl.ds(j * block_k, block_k)]
+                s = jnp.where(sq_ids[:, None] == sk_ids[None, :], s, NEG_INF)
+            p = jnp.exp(s - lse[:, None])
+            if segments or off < 0:
+                # Fully-masked rows (segment masks, or causal sq > sk — see
+                # _fwd_kernel) have a degenerate lse; force exact zeros.
+                p = jnp.where(s <= NEG_INF / 2, 0.0, p)
+            dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+            ds = (p * (dp - delta[:, None]) * scale).astype(k.dtype)
+            return dq + jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
+                                            preferred_element_type=jnp.float32)
+        return body
 
     if resident:
-        dq = jax.lax.fori_loop(0, n_inner(), body,
-                               jnp.zeros((block_q, q.shape[-1]), jnp.float32))
+        init = jnp.zeros((block_q, q.shape[-1]), jnp.float32)
+        if diag_split:
+            tri = _causal_tri(block_q, block_k)
+            dq = jax.lax.fori_loop(0, qi, make_body(False, None), init)
+            dq = make_body(False, tri)(qi, dq)
+        else:
+            dq = jax.lax.fori_loop(0, n_inner(), make_body(causal, None),
+                                   init)
         dq_ref[0] = dq.astype(dq_ref.dtype)
         return
 
@@ -338,7 +387,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
 
     @pl.when(run)
     def _superblock_body():
-        dq_s[...] = jax.lax.fori_loop(0, n_inner(), body, dq_s[...])
+        dq_s[...] = jax.lax.fori_loop(0, n_inner(),
+                                      make_body(causal, None), dq_s[...])
 
     @pl.when(kb == n_sb - 1)
     def _emit():
@@ -374,43 +424,58 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
                             sb // block_q)
         return 0
 
-    def body(i, carry):
-        dk, dv = carry
-        q = q_ref[0, pl.ds(i * block_q, block_q), :]
-        do = do_ref[0, pl.ds(i * block_q, block_q), :]
-        lse = lse_ref[0, 0, pl.ds(i * block_q, block_q)]
-        delta = delta_ref[0, 0, pl.ds(i * block_q, block_q)]
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
-        if causal:
-            row = base + i * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, s.shape, 0)
-            col = first_col + jax.lax.broadcasted_iota(
-                jnp.int32, s.shape, 1)
-            s = jnp.where(row + off >= col, s, NEG_INF)
-        if segments:
-            sq_ids = segq_ref[0, 0, pl.ds(i * block_q, block_q)]
-            sk_ids = segk_ref[0, 0]
-            s = jnp.where(sq_ids[:, None] == sk_ids[None, :], s, NEG_INF)
-        p = jnp.exp(s - lse[:, None])
-        if segments or off < 0:
-            # Fully-masked rows (segment masks, or causal sq > sk — see
-            # _fwd_kernel) have a degenerate lse; force exact zeros.
-            p = jnp.where(s <= NEG_INF / 2, 0.0, p)
-        dv = dv + jax.lax.dot_general(p.astype(do.dtype), do,
-                                      (((0,), (0,)), ((), ())),
-                                      preferred_element_type=jnp.float32)
-        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
-        ds = (p * (dp - delta[:, None]) * scale).astype(q.dtype)
-        dk = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
-                                      preferred_element_type=jnp.float32)
-        return dk, dv
+    diag_split = _diag_split(causal, off, resident, segments,
+                             block_q, block_k)
+
+    def make_body(general_mask: bool, bias):
+        def body(i, carry):
+            dk, dv = carry
+            q = q_ref[0, pl.ds(i * block_q, block_q), :]
+            do = do_ref[0, pl.ds(i * block_q, block_q), :]
+            lse = lse_ref[0, 0, pl.ds(i * block_q, block_q)]
+            delta = delta_ref[0, 0, pl.ds(i * block_q, block_q)]
+            s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                    preferred_element_type=jnp.float32) * scale
+            if bias is not None:
+                s = s + bias
+            if general_mask:
+                row = base + i * block_q + jax.lax.broadcasted_iota(
+                    jnp.int32, s.shape, 0)
+                col = first_col + jax.lax.broadcasted_iota(
+                    jnp.int32, s.shape, 1)
+                s = jnp.where(row + off >= col, s, NEG_INF)
+            if segments:
+                sq_ids = segq_ref[0, 0, pl.ds(i * block_q, block_q)]
+                sk_ids = segk_ref[0, 0]
+                s = jnp.where(sq_ids[:, None] == sk_ids[None, :], s, NEG_INF)
+            p = jnp.exp(s - lse[:, None])
+            if segments or off < 0:
+                # Fully-masked rows (segment masks, or causal sq > sk — see
+                # _fwd_kernel) have a degenerate lse; force exact zeros.
+                p = jnp.where(s <= NEG_INF / 2, 0.0, p)
+            dv = dv + jax.lax.dot_general(p.astype(do.dtype), do,
+                                          (((0,), (0,)), ((), ())),
+                                          preferred_element_type=jnp.float32)
+            dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+            ds = (p * (dp - delta[:, None]) * scale).astype(q.dtype)
+            dk = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                                          preferred_element_type=jnp.float32)
+            return dk, dv
+        return body
 
     if resident:
         zero = lambda a: jnp.zeros(a.shape, jnp.float32)
-        dk, dv = jax.lax.fori_loop(first_inner(), sb // block_q, body,
-                                   (zero(k), zero(v)))
+        init = (zero(k), zero(v))
+        if diag_split:
+            # Diagonal q block i == ki (triangular bias), full blocks after.
+            tri = _causal_tri(block_q, block_k)
+            dk, dv = make_body(False, tri)(ki, init)
+            dk, dv = jax.lax.fori_loop(ki + 1, sb // block_q,
+                                       make_body(False, None), (dk, dv))
+        else:
+            dk, dv = jax.lax.fori_loop(first_inner(), sb // block_q,
+                                       make_body(causal, None), init)
         dk_ref[0] = dk.astype(dk_ref.dtype)
         dv_ref[0] = dv.astype(dv_ref.dtype)
         return
@@ -426,7 +491,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
 
     @pl.when(run)
     def _superblock_body():
-        dk, dv = jax.lax.fori_loop(first_inner(), sb // block_q, body,
+        dk, dv = jax.lax.fori_loop(first_inner(), sb // block_q,
+                                   make_body(causal, None),
                                    (dk_s[...], dv_s[...]))
         dk_s[...], dv_s[...] = dk, dv
 

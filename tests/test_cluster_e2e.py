@@ -39,7 +39,8 @@ def _cluster_context():
         probe = _run(["kubectl", "cluster-info", "--request-timeout=5s"])
         if probe.returncode == 0:
             return ("kubectl", None)
-    if shutil.which("kind") and shutil.which("docker"):
+    if (shutil.which("kind") and shutil.which("docker")
+            and shutil.which("kubectl")):
         docker_ok = _run(["docker", "info"], timeout=30).returncode == 0
         if docker_ok:
             return ("kind", f"kddl-e2e-{uuid.uuid4().hex[:6]}")
@@ -58,7 +59,10 @@ def test_rendered_job_runs_on_cluster():
                         "--wait", "120s"], timeout=300)
         assert created.returncode == 0, created.stderr
 
-    cfg = JobConfig(name=f"e2e-{uuid.uuid4().hex[:6]}", namespace="kddl-e2e",
+    run_id = uuid.uuid4().hex[:6]
+    # Unique namespace per run: the finally-block deletes the whole
+    # namespace, which must not take out a concurrent run's job.
+    cfg = JobConfig(name=f"e2e-{run_id}", namespace=f"kddl-e2e-{run_id}",
                     num_workers=2, cpu="100m", memory="128Mi")
     objs = render.render_all(cfg)
     # Swap in a stock image + env-echo command and drop the TPU scheduling
