@@ -89,8 +89,8 @@ def _diag_split(causal: bool, off: int, resident: bool, segments: bool,
     computed once per grid cell instead of two iotas + compare + select per
     block. The kernels are VPU-bound, so dropping those per-block passes is
     the win (BENCHMARKS.md round 3)."""
-    return (causal and off == 0 and resident and not segments
-            and block_q == block_k)
+    return resident and _stream_split(causal, off, segments,
+                                      block_q, block_k)
 
 
 def _causal_tri(block_q: int, block_k: int) -> jax.Array:
@@ -100,6 +100,15 @@ def _causal_tri(block_q: int, block_k: int) -> jax.Array:
         jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
         >= jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1),
         0.0, NEG_INF)
+
+
+def _stream_split(causal: bool, off: int, segments: bool,
+                  block_q: int, block_k: int) -> bool:
+    """Streaming variant of :func:`_diag_split` (same static conditions
+    minus residency): inside the superblock holding the diagonal, the
+    boundary fine block is THE diagonal block; every other executed block
+    is fully visible."""
+    return causal and off == 0 and not segments and block_q == block_k
 
 
 # ---------------------------------------------------------------- forward
@@ -212,13 +221,26 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest,
         acc_s[...] = jnp.zeros_like(acc_s)
 
     run = base <= last_row if causal else True
+    stream_split = _stream_split(causal, off, segments, block_q, block_k)
 
     @pl.when(run)
     def _superblock_body():
-        m, l, acc = jax.lax.fori_loop(
-            0, n_inner(), make_body(causal, None),
-            (m_s[...], l_s[...], acc_s[...]))
-        m_s[...], l_s[...], acc_s[...] = m, l, acc
+        carry = (m_s[...], l_s[...], acc_s[...])
+        if stream_split:
+            has_diag = jnp.logical_and(base <= qi * block_q,
+                                       qi * block_q < base + sb)
+            carry = jax.lax.fori_loop(
+                0, n_inner() - has_diag.astype(jnp.int32),
+                make_body(False, None), carry)
+            tri = _causal_tri(block_q, block_k)
+            carry = jax.lax.cond(
+                has_diag,
+                lambda c: make_body(False, tri)(n_inner() - 1, c),
+                lambda c: c, carry)
+        else:
+            carry = jax.lax.fori_loop(0, n_inner(), make_body(causal, None),
+                                      carry)
+        m_s[...], l_s[...], acc_s[...] = carry
 
     @pl.when(kb == n_sb - 1)
     def _emit():
@@ -387,8 +409,21 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
 
     @pl.when(run)
     def _superblock_body():
-        dq_s[...] = jax.lax.fori_loop(0, n_inner(),
-                                      make_body(causal, None), dq_s[...])
+        # Streaming diagonal-split mirrors _fwd_kernel's.
+        if _stream_split(causal, off, segments, block_q, block_k):
+            has_diag = jnp.logical_and(base <= qi * block_q,
+                                       qi * block_q < base + sb)
+            dq = jax.lax.fori_loop(
+                0, n_inner() - has_diag.astype(jnp.int32),
+                make_body(False, None), dq_s[...])
+            tri = _causal_tri(block_q, block_k)
+            dq_s[...] = jax.lax.cond(
+                has_diag,
+                lambda c: make_body(False, tri)(n_inner() - 1, c),
+                lambda c: c, dq)
+        else:
+            dq_s[...] = jax.lax.fori_loop(0, n_inner(),
+                                          make_body(causal, None), dq_s[...])
 
     @pl.when(kb == n_sb - 1)
     def _emit():
@@ -491,10 +526,25 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
 
     @pl.when(run)
     def _superblock_body():
-        dk, dv = jax.lax.fori_loop(first_inner(), sb // block_q,
-                                   make_body(causal, None),
-                                   (dk_s[...], dv_s[...]))
-        dk_s[...], dv_s[...] = dk, dv
+        carry = (dk_s[...], dv_s[...])
+        # Streaming diagonal-split: the diagonal q block (when this Q
+        # superblock holds it) is exactly first_inner(); later blocks see
+        # this k block in full.
+        if _stream_split(causal, off, segments, block_q, block_k):
+            has_diag = jnp.logical_and(base <= ki * block_k,
+                                       ki * block_k < base + sb)
+            tri = _causal_tri(block_q, block_k)
+            carry = jax.lax.cond(
+                has_diag,
+                lambda c: make_body(False, tri)(first_inner(), c),
+                lambda c: c, carry)
+            carry = jax.lax.fori_loop(
+                first_inner() + has_diag.astype(jnp.int32), sb // block_q,
+                make_body(False, None), carry)
+        else:
+            carry = jax.lax.fori_loop(first_inner(), sb // block_q,
+                                      make_body(causal, None), carry)
+        dk_s[...], dv_s[...] = carry
 
     @pl.when(qb == n_sb - 1)
     def _emit():
