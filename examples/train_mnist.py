@@ -151,12 +151,15 @@ def main(argv: list[str] | None = None) -> dict:
             test_x, test_y = data_lib.load_or_synthesize(conf.data_dir, "test",
                                                          seed=conf.seed)
             eval_step = jax.jit(lambda p, b: mnist.eval_fn(model, p, b))
-            n = min(len(test_x), 2000)
+            # Real data: the full held-out split (the >=99% gate must cover
+            # all 10k test examples); synthetic: capped for smoke speed.
+            n = len(test_x) if conf.data_dir else min(len(test_x), 2000)
             bs = 200
             ev = loop.evaluate(eval_step, state.params,
                                iter(ShardedBatcher(test_x[:n], test_y[:n], bs,
                                                    seed=conf.seed)),
                                num_batches=max(1, n // bs))
+            ev["eval_examples"] = (n // bs) * bs
             metrics.emit("eval", **{k: float(v) for k, v in ev.items()})
             if distributed.is_primary():
                 result.update(ev)
@@ -165,6 +168,38 @@ def main(argv: list[str] | None = None) -> dict:
         ckpt.close()
         metrics.close()
     return result
+
+
+def run_accuracy_gate(data_dir: str, checkpoint_dir: str,
+                      steps: int | None = None) -> float:
+    """The single source of truth for the >=99% north-star gate: train the
+    reference's deployed config (batch 100, Adam 1e-3 x world, default
+    20000 // world steps — ``tensorflow_mnist.py:33-34,123,146``) on real
+    MNIST through the DP engine, evaluate the FULL 10k test split, and
+    assert >= 0.99. Called by both ``bench.py --suite mnist|all`` and
+    ``tests/test_mnist_convergence.py`` so the two can never drift apart.
+    *checkpoint_dir* must be fresh — a stale dir would restore a finished
+    run and certify params the current code never trained. Returns the
+    measured accuracy."""
+    if steps is None:
+        steps = int(os.environ.get("MNIST_STEPS", "20000"))
+    if os.path.isdir(checkpoint_dir) and os.listdir(checkpoint_dir):
+        raise ValueError(
+            f"checkpoint_dir {checkpoint_dir!r} is non-empty: the gate "
+            "would resume a finished run instead of training")
+    result = main([
+        "--data-dir", data_dir,
+        "--num-steps", str(steps),
+        "--batch-size", "100",
+        "--lr", "0.001",
+        "--checkpoint-dir", checkpoint_dir,
+        "--log-every", "500",
+    ])
+    assert result.get("eval_examples") == 10_000, (
+        "gate must cover the full test split", result)
+    acc = float(result["accuracy"])
+    assert acc >= 0.99, f"north-star gate FAILED: {acc:.4f} < 0.99"
+    return acc
 
 
 if __name__ == "__main__":

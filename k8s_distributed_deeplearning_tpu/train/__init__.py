@@ -4,7 +4,10 @@ from k8s_distributed_deeplearning_tpu.train.data import (  # noqa: F401
     PackedTokenBatcher,
     ShardedBatcher,
     TokenBatcher,
+    fetch_mnist,
     load_mnist,
+    mnist_available,
+    resolve_mnist_dir,
     split_documents,
     synthetic_images,
     synthetic_mnist,

@@ -13,13 +13,146 @@ procedural synthetic set).
 from __future__ import annotations
 
 import gzip
+import hashlib
 import os
 import struct
-from typing import Iterator
+import tempfile
+import urllib.error
+import urllib.request
+from typing import Iterator, Mapping
 
 import numpy as np
 
 PyTree = dict
+
+# The four canonical MNIST idx archives with their well-known MD5 digests
+# (the same pins torchvision ships). The reference downloads MNIST through
+# keras per rank (``tensorflow_mnist.py:97-115``) with no integrity check;
+# here the fetch is checksummed and shared (one dir, atomic writes) so a
+# truncated or tampered download can never train silently.
+MNIST_FILES: dict[str, str] = {
+    "train-images-idx3-ubyte.gz": "f68b3c2dcbeaaa9fbdd348bbdeb94873",
+    "train-labels-idx1-ubyte.gz": "d53e105ee54ea40749a09fcbcd1e9432",
+    "t10k-images-idx3-ubyte.gz": "9fb629c4189551a2d022fa330f9573f3",
+    "t10k-labels-idx1-ubyte.gz": "ec29112dd5afa0611ce80d1b7f02629c",
+}
+
+# Stable public mirrors (yann.lecun.com rate-limits and 403s CI fetches).
+MNIST_MIRRORS = (
+    "https://ossci-datasets.s3.amazonaws.com/mnist/",
+    "https://storage.googleapis.com/cvdf-datasets/mnist/",
+)
+
+DEFAULT_MNIST_DIR = os.path.join(
+    os.path.expanduser("~"), ".cache", "k8s_ddl_tpu", "mnist")
+
+
+class ChecksumError(RuntimeError):
+    """A fetched/on-disk dataset file does not match its pinned digest."""
+
+
+def _md5(path: str) -> str:
+    h = hashlib.md5()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def mnist_available(data_dir: str,
+                    checksums: Mapping[str, str] | None = None,
+                    verify: bool = True) -> bool:
+    """True iff all four idx archives exist in *data_dir* (and, when
+    *verify*, match their pinned MD5 digests). Unpacked (non-.gz) files are
+    accepted without digest verification — the pins are for the archives."""
+    checksums = MNIST_FILES if checksums is None else checksums
+    for name, digest in checksums.items():
+        gz = os.path.join(data_dir, name)
+        if os.path.exists(gz):
+            if verify and _md5(gz) != digest:
+                return False
+        elif not os.path.exists(os.path.join(data_dir, name[:-3])):
+            return False
+    return True
+
+
+def fetch_mnist(data_dir: str | None = None, *,
+                mirrors: tuple[str, ...] = MNIST_MIRRORS,
+                checksums: Mapping[str, str] | None = None,
+                timeout: float = 60.0) -> str:
+    """Ensure the real MNIST idx archives exist in *data_dir*, fetching any
+    missing/corrupt file from the first reachable mirror, verifying every
+    byte against the pinned digests. Returns the directory. Raises
+    :class:`ChecksumError` on digest mismatch and ``OSError`` when no mirror
+    is reachable (zero-egress environments).
+
+    Atomic: downloads land in ``<name>.part`` and are renamed only after the
+    digest checks out, so a killed fetch can never leave a plausible-looking
+    truncated file (contrast the reference's per-rank unchecked keras
+    download, ``tensorflow_mnist.py:97-115``).
+    """
+    data_dir = data_dir or os.environ.get("MNIST_DATA_DIR") or DEFAULT_MNIST_DIR
+    checksums = MNIST_FILES if checksums is None else checksums
+    os.makedirs(data_dir, exist_ok=True)
+    for name, digest in checksums.items():
+        dest = os.path.join(data_dir, name)
+        if os.path.exists(dest) and _md5(dest) == digest:
+            continue
+        last_err: Exception | None = None
+        for mirror in mirrors:
+            url = mirror + name
+            # Per-process unique temp name: concurrent ranks fetching into a
+            # shared dir must never interleave writes or delete each other's
+            # in-progress download; the winner's os.replace is atomic and
+            # later ranks see a digest-clean file and skip.
+            fd, part = tempfile.mkstemp(prefix=name + ".", suffix=".part",
+                                        dir=data_dir)
+            try:
+                with urllib.request.urlopen(url, timeout=timeout) as r, \
+                        os.fdopen(fd, "wb") as f:
+                    fd = None
+                    for chunk in iter(lambda: r.read(1 << 20), b""):
+                        f.write(chunk)
+                got = _md5(part)
+                if got != digest:
+                    os.remove(part)
+                    raise ChecksumError(
+                        f"{url}: MD5 {got} != pinned {digest}")
+                os.replace(part, dest)
+                last_err = None
+                break
+            except ChecksumError:
+                raise  # a bad digest from a live mirror is never retried away
+            except (urllib.error.URLError, OSError, TimeoutError) as e:
+                last_err = e
+                if fd is not None:
+                    os.close(fd)
+                    fd = None
+                if os.path.exists(part):
+                    os.remove(part)
+        if last_err is not None:
+            raise OSError(
+                f"could not fetch {name} from any mirror "
+                f"({', '.join(mirrors)}): {last_err}")
+    return data_dir
+
+
+def resolve_mnist_dir(data_dir: str | None = None, *,
+                      fetch: bool | None = None) -> str | None:
+    """Locate real MNIST: explicit *data_dir*, else ``$MNIST_DATA_DIR``, else
+    the default cache dir. Returns None when absent — unless *fetch* (default:
+    ``$MNIST_FETCH=1``) is set, in which case a checksummed download is
+    attempted and fetch failures propagate."""
+    candidates = [d for d in (data_dir, os.environ.get("MNIST_DATA_DIR"),
+                              DEFAULT_MNIST_DIR) if d]
+    for d in candidates:
+        if os.path.isdir(d) and mnist_available(d):
+            return d
+    if fetch is None:
+        fetch = os.environ.get("MNIST_FETCH", "") == "1"
+    if fetch:
+        return fetch_mnist(candidates[0])
+    return None
 
 
 def _open_maybe_gz(path: str):

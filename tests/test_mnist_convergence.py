@@ -1,0 +1,162 @@
+"""Real-MNIST convergence gate + checksummed-fetch unit tests.
+
+The reference's deployed workload trains *real* MNIST
+(``tensorflow_mnist.py:97-115`` downloads it per rank, ``:160-171`` trains)
+and its Keras variant prints test accuracy (``tensorflow_mnist_gpu.py:184-188``)
+without asserting anything. This file is the stronger TPU-native contract:
+when the real idx files are present (``MNIST_DATA_DIR``, the default cache
+dir, or ``MNIST_FETCH=1``), training through the real DP engine must reach
+**>= 99.0% test accuracy over the full 10k test split** — the BASELINE.md
+north star. In zero-egress environments without the data the gate SKIPS
+loudly; it never silently passes on synthetic data.
+
+The fetch/verify unit tests below run everywhere (file:// mirrors, no
+network) so the integrity logic itself is always covered.
+"""
+from __future__ import annotations
+
+import hashlib
+import pathlib
+
+import pytest
+
+from k8s_distributed_deeplearning_tpu.train import data as data_lib
+
+
+def _real_dir_or_skip() -> str:
+    """Resolve real MNIST lazily (inside the test, never at collection —
+    MNIST_FETCH=1 triggers network I/O) and skip with an actionable reason
+    when unavailable."""
+    try:
+        real = data_lib.resolve_mnist_dir()
+    except OSError as e:
+        pytest.skip(f"MNIST fetch failed (zero-egress?): {e}")
+    if real is None:
+        pytest.skip(
+            "real MNIST idx files not available: set MNIST_DATA_DIR to a "
+            "dir with the four idx archives, or MNIST_FETCH=1 to download "
+            "with checksum verification")
+    return real
+
+
+# ---------------------------------------------------------------- fetch unit
+
+def _mirror_with(tmp_path: pathlib.Path, contents: dict[str, bytes]):
+    mdir = tmp_path / "mirror"
+    mdir.mkdir()
+    sums = {}
+    for name, blob in contents.items():
+        (mdir / name).write_bytes(blob)
+        sums[name] = hashlib.md5(blob).hexdigest()
+    return mdir.as_uri() + "/", sums
+
+
+def test_fetch_verifies_and_is_idempotent(tmp_path):
+    url, sums = _mirror_with(tmp_path, {"train-images-idx3-ubyte.gz": b"A" * 100})
+    dest = tmp_path / "data"
+    out = data_lib.fetch_mnist(str(dest), mirrors=(url,), checksums=sums)
+    assert out == str(dest)
+    assert (dest / "train-images-idx3-ubyte.gz").read_bytes() == b"A" * 100
+    # Second call: files present + digests match -> no mirror access needed.
+    data_lib.fetch_mnist(str(dest), mirrors=("file:///nonexistent/",),
+                         checksums=sums)
+
+
+def test_fetch_rejects_corrupt_mirror(tmp_path):
+    url, _ = _mirror_with(tmp_path, {"t10k-labels-idx1-ubyte.gz": b"evil"})
+    with pytest.raises(data_lib.ChecksumError):
+        data_lib.fetch_mnist(str(tmp_path / "d"), mirrors=(url,),
+                             checksums={"t10k-labels-idx1-ubyte.gz": "0" * 32})
+    # The atomic temp-file protocol must leave no plausible-looking file
+    # nor any orphaned *.part temp behind.
+    assert not (tmp_path / "d" / "t10k-labels-idx1-ubyte.gz").exists()
+    assert list((tmp_path / "d").glob("*.part")) == []
+
+
+def test_fetch_repairs_corrupt_local_file(tmp_path):
+    url, sums = _mirror_with(tmp_path, {"train-labels-idx1-ubyte.gz": b"good"})
+    dest = tmp_path / "data"
+    dest.mkdir()
+    (dest / "train-labels-idx1-ubyte.gz").write_bytes(b"truncated")
+    data_lib.fetch_mnist(str(dest), mirrors=(url,), checksums=sums)
+    assert (dest / "train-labels-idx1-ubyte.gz").read_bytes() == b"good"
+
+
+def test_fetch_unreachable_mirrors_raise_oserror(tmp_path):
+    with pytest.raises(OSError):
+        data_lib.fetch_mnist(str(tmp_path / "d"),
+                             mirrors=((tmp_path / "nope").as_uri() + "/",),
+                             checksums={"x.gz": "0" * 32})
+
+
+def test_mnist_available_checks_digests(tmp_path):
+    (tmp_path / "a.gz").write_bytes(b"hello")
+    good = hashlib.md5(b"hello").hexdigest()
+    assert data_lib.mnist_available(str(tmp_path), checksums={"a.gz": good})
+    assert not data_lib.mnist_available(str(tmp_path),
+                                        checksums={"a.gz": "0" * 32})
+    assert not data_lib.mnist_available(str(tmp_path),
+                                        checksums={"missing.gz": good})
+
+
+def test_resolve_absent_returns_none(tmp_path, monkeypatch):
+    monkeypatch.delenv("MNIST_DATA_DIR", raising=False)
+    monkeypatch.delenv("MNIST_FETCH", raising=False)
+    monkeypatch.setattr(data_lib, "DEFAULT_MNIST_DIR", str(tmp_path / "none"))
+    assert data_lib.resolve_mnist_dir() is None
+
+
+def _write_idx_dataset(dirpath: pathlib.Path, n_train: int = 600,
+                       n_test: int = 200) -> None:
+    """Synthetic MNIST-shaped data in the real on-disk idx format, so the
+    exact --data-dir code path the >=99% gate drives (idx parse -> batcher
+    -> DP engine -> full-split eval) is covered in zero-egress CI."""
+    import gzip
+    import struct
+
+    import numpy as np
+
+    xs, ys = data_lib.synthetic_mnist(n_train + n_test, seed=3)
+    xs = (xs[..., 0] * 255).astype(np.uint8)
+    ys = ys.astype(np.uint8)
+    splits = {"train": (xs[:n_train], ys[:n_train]),
+              "t10k": (xs[n_train:], ys[n_train:])}
+    for prefix, (x, y) in splits.items():
+        with gzip.open(dirpath / f"{prefix}-images-idx3-ubyte.gz", "wb") as f:
+            f.write(struct.pack(">I", 0x00000803)
+                    + struct.pack(">III", len(x), 28, 28) + x.tobytes())
+        with gzip.open(dirpath / f"{prefix}-labels-idx1-ubyte.gz", "wb") as f:
+            f.write(struct.pack(">I", 0x00000801)
+                    + struct.pack(">I", len(y)) + y.tobytes())
+
+
+def test_gate_mechanics_on_idx_files(tmp_path):
+    """Everything the real-data gate does, minus the 99% bar: idx files on
+    disk, --data-dir training, final eval over the FULL test split."""
+    from examples import train_mnist
+
+    data = tmp_path / "idx"
+    data.mkdir()
+    _write_idx_dataset(data)
+    result = train_mnist.main([
+        "--data-dir", str(data), "--num-steps", "30", "--batch-size", "32",
+        "--checkpoint-dir", str(tmp_path / "ckpt"),
+        "--log-every", "10"])
+    assert result["eval_examples"] == 200  # full split, not the 2000-cap path
+    assert 0.0 <= result["accuracy"] <= 1.0
+
+
+# -------------------------------------------------------- convergence gate
+
+@pytest.mark.slow
+def test_real_mnist_converges_to_99(tmp_path):
+    """The north-star gate: reference deployed config (batch 100, Adam
+    1e-3 x world, steps 20000 // world — ``tensorflow_mnist.py:33-34,123,146``)
+    through the real DP engine on real data must reach >= 99.0% accuracy on
+    the full held-out test split. Shares its entire definition with
+    ``bench.py --suite mnist`` via ``train_mnist.run_accuracy_gate``."""
+    from examples import train_mnist
+
+    real = _real_dir_or_skip()
+    acc = train_mnist.run_accuracy_gate(real, str(tmp_path / "ckpt"))
+    assert acc >= 0.99  # run_accuracy_gate already asserts; keep it visible
