@@ -1,27 +1,39 @@
 """Mixture-of-Experts layers + expert parallelism.
 
 Absent from the reference (SURVEY.md §2c lists EP as a gap to fill); built
-TPU-first: routing is the dense one-hot dispatch/combine formulation (Switch
-Transformer style) — every tensor is static-shaped, the dispatch and combine
-are einsums that tile onto the MXU, and there is no scatter/gather or
-data-dependent shape anywhere, so XLA can compile and overlap the all-to-all
-the sharding induces.
+TPU-first with two dispatch mechanisms, both fully static-shaped:
+
+- ``dispatch="index"`` (default): index-based dispatch — position-in-
+  expert from k cumsum passes over [T, E] (the same capacity accounting
+  the einsum path uses), then k direct scatters build the [E, C, d]
+  expert buffers and a pure gather combines. O(T·k·d) memory traffic and
+  no sort, replacing the round-3 dense one-hot einsums whose
+  dispatch/combine cost T·E·C·d MAC each — at 8 experts that dense path
+  burned ~half the layer's FLOPs moving zeros (BENCHMARKS.md r3 MoE
+  table: 22-26% MFU vs 47% dense; the index path measures 33-36%).
+- ``dispatch="einsum"``: the Switch-style dense one-hot formulation,
+  retained as the readable reference both for parity tests and for meshes
+  where a contraction lowers better than scatter.
 
 Expert parallelism falls out of the logical-axis system: expert weights carry
 the "expert" logical axis -> the rule table maps it to the "expert" mesh axis
 -> dispatching tokens (sharded over "data") into expert buffers (sharded over
-"expert") makes XLA emit the all-to-all, exactly where a hand-written MoE
-framework would place NCCL alltoall calls.
+"expert") makes XLA emit the collective a hand-written MoE framework would
+place as NCCL alltoall calls.
 
 Router details: top-k gating with renormalized probabilities, position-in-
 expert by cumulative sum (earlier tokens win capacity), overflow tokens pass
 through the residual unchanged (standard drop policy), Switch load-balance
-aux loss + router z-loss exposed via ``sow("intermediates", ...)``.
+aux loss + router z-loss exposed via ``sow("intermediates", ...)``. Both
+dispatch mechanisms implement IDENTICAL routing semantics (same keep set:
+drops only start once an expert is full, after which both drop everything
+later in choice-major order) — asserted by parity tests.
 """
 from __future__ import annotations
 
 import dataclasses
 import functools
+import warnings
 from typing import Any
 
 import flax.linen as nn
@@ -56,29 +68,44 @@ class MoEConfig:
     aux_loss_weight: float = 0.01
     router_z_weight: float = 1e-3
     routing: str = "topk"            # "topk" | "expert_choice"
+    dispatch: str = "index"          # "index" | "einsum" (see module docstring)
 
     def __post_init__(self):
         if self.routing not in ("topk", "expert_choice"):
             raise ValueError(f"routing must be 'topk' or 'expert_choice', "
                              f"got {self.routing!r}")
+        if self.dispatch not in ("index", "einsum"):
+            raise ValueError(f"dispatch must be 'index' or 'einsum', "
+                             f"got {self.dispatch!r}")
 
 
-def top_k_routing(logits: jax.Array, k: int, capacity: int):
-    """Static-shape top-k routing.
+def clamped_capacity(tokens: int, moe: "MoEConfig") -> int:
+    """Per-expert buffer capacity: capacity_factor·k·T/E, int-floored,
+    clamped to [1, T]. THE single formula — MoEMLP sizes its buffers with
+    it and :func:`flops_per_token` derives exact active slots from it
+    (capacity_factor*top_k > num_experts would otherwise push raw capacity
+    past T: expert choice's top_k over the token axis would be ill-formed,
+    and topk slots beyond T can never fill)."""
+    return min(tokens, max(1, int(moe.capacity_factor * moe.top_k
+                                  * tokens / moe.num_experts)))
 
-    logits: [T, E] router scores. Returns (dispatch [T, E, C] bool,
-    combine [T, E, C] f32, aux_metrics dict). Token t's c-th capacity slot in
-    expert e is set when t routed there and fewer than C earlier tokens did.
-    """
+
+def _topk_assignments(logits: jax.Array, k: int):
+    """Greedy top-k expert choices shared by both dispatch mechanisms.
+
+    Returns (probs [T, E] f32, idx list of k [T] int32 expert picks,
+    assign list of k one-hot [T, E], gate_stack [k, T] renormalized)."""
     t, e = logits.shape
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
 
     remaining = probs
+    idx_list = []   # k [T] argmax picks
     assign = []     # k one-hot [T, E] masks
     gates = []      # k [T] gate values
     for _ in range(k):
         idx = jnp.argmax(remaining, axis=-1)
         one_hot = jax.nn.one_hot(idx, e, dtype=jnp.float32)
+        idx_list.append(idx.astype(jnp.int32))
         assign.append(one_hot)
         gates.append(jnp.sum(probs * one_hot, axis=-1))
         remaining = remaining * (1.0 - one_hot)
@@ -87,6 +114,76 @@ def top_k_routing(logits: jax.Array, k: int, capacity: int):
     gate_stack = jnp.stack(gates, axis=0)                     # [k, T]
     gate_stack = gate_stack / jnp.maximum(
         jnp.sum(gate_stack, axis=0, keepdims=True), 1e-9)
+    return probs, idx_list, assign, gate_stack
+
+
+def _z_loss(logits: jax.Array) -> jax.Array:
+    """Router z-loss (one definition for every routing/dispatch path)."""
+    return jnp.mean(jnp.square(jax.nn.logsumexp(
+        logits.astype(jnp.float32), axis=-1)))
+
+
+def _router_aux(logits: jax.Array, probs: jax.Array,
+                assign0: jax.Array) -> dict:
+    """Switch load-balance loss + router z-loss (shared by both paths)."""
+    e = logits.shape[1]
+    return {
+        "load_balance_loss": e * jnp.sum(jnp.mean(assign0, axis=0)
+                                         * jnp.mean(probs, axis=0)),
+        "router_z_loss": _z_loss(logits),
+    }
+
+
+def _expert_choice_picks(logits: jax.Array, capacity: int):
+    """Expert-choice selection shared by both dispatch paths: each expert
+    takes its top-``capacity`` tokens by softmax affinity. Returns
+    (gates [E, C] f32, idx [E, C] int32)."""
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    return jax.lax.top_k(probs.T, capacity)
+
+
+def top_k_dispatch_indices(logits: jax.Array, k: int, capacity: int):
+    """Index-based top-k routing: the same keep set as :func:`top_k_routing`
+    (identical cumsum capacity accounting — choice 0 takes priority, then
+    token order) expressed as direct scatter/gather indices instead of
+    [T, E, C] one-hots. Costs k cumsum passes over [T, E] — no sort, no
+    slot one-hot, no dense dispatch/combine contraction.
+
+    Returns (dest [k, T] int32 flat E*C buffer destination per choice
+    (== E*C sentinel when dropped), gate [k, T] f32 renormalized gates,
+    keep [k, T] bool, aux dict). All shapes static.
+    """
+    t, e = logits.shape
+    probs, idx_list, assign, gate_stack = _topk_assignments(logits, k)
+
+    used = jnp.zeros((e,), jnp.float32)       # kept slots from earlier choices
+    dests, keeps = [], []
+    for c in range(k):
+        one_hot = assign[c]                                   # [T, E]
+        pos = jnp.cumsum(one_hot, axis=0) - one_hot + used    # [T, E]
+        keep_m = one_hot * (pos < capacity)
+        used = used + jnp.sum(keep_m, axis=0)
+        pos_t = jnp.sum(pos * one_hot, axis=-1).astype(jnp.int32)  # [T]
+        kept = jnp.sum(keep_m, axis=-1) > 0                        # [T]
+        dests.append(jnp.where(kept, idx_list[c] * capacity + pos_t,
+                               e * capacity))
+        keeps.append(kept)
+    dest, keep = jnp.stack(dests), jnp.stack(keeps)
+
+    aux = dict(_router_aux(logits, probs, assign[0]),
+               fraction_dropped=1.0 - jnp.mean(keep.astype(jnp.float32)))
+    return dest, gate_stack, keep, aux
+
+
+def top_k_routing(logits: jax.Array, k: int, capacity: int):
+    """Static-shape top-k routing (dense one-hot formulation).
+
+    logits: [T, E] router scores. Returns (dispatch [T, E, C] bool,
+    combine [T, E, C] f32, aux_metrics dict). Token t's c-th capacity slot in
+    expert e is set when t routed there and fewer than C earlier tokens did.
+    """
+    t, e = logits.shape
+    probs, _, assign, gate_stack = _topk_assignments(logits, k)
 
     dispatch = jnp.zeros((t, e, capacity), jnp.bool_)
     combine = jnp.zeros((t, e, capacity), jnp.float32)
@@ -127,27 +224,25 @@ def expert_choice_routing(logits: jax.Array, capacity: int):
     picked (they ride the residual unchanged — the scheme's dual trade).
     """
     t, e = logits.shape
-    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)   # [T, E]
-    gates, idx = jax.lax.top_k(probs.T, capacity)                 # [E, C]
+    gates, idx = _expert_choice_picks(logits, capacity)           # [E, C]
     sel = jax.nn.one_hot(idx, t, dtype=jnp.float32)               # [E, C, T]
     dispatch = sel.transpose(2, 0, 1) > 0                         # [T, E, C]
     combine = sel.transpose(2, 0, 1) * gates[None]                # [T, E, C]
     covered = jnp.clip(jnp.sum(dispatch, axis=(1, 2)), 0, 1)      # [T]
     aux = {
-        "router_z_loss": jnp.mean(
-            jnp.square(jax.nn.logsumexp(logits.astype(jnp.float32),
-                                        axis=-1))),
+        "router_z_loss": _z_loss(logits),
         "fraction_dropped": 1.0 - jnp.mean(covered),
     }
     return dispatch, combine, aux
 
 
 class MoEMLP(nn.Module):
-    """Expert-parallel SwiGLU MLP with top-k routing.
+    """Expert-parallel SwiGLU MLP with top-k or expert-choice routing.
 
-    Expert weights are [E, ...] with the "expert" logical axis; dispatch and
-    combine einsums bridge token-sharding to expert-sharding (XLA inserts the
-    all-to-all when the mesh has an expert axis).
+    Expert weights are [E, ...] with the "expert" logical axis; the
+    dispatch/combine (argsort+scatter by default, dense one-hot einsums
+    with ``dispatch="einsum"``) bridges token-sharding to expert-sharding
+    (XLA inserts the collective when the mesh has an expert axis).
     """
 
     cfg: TransformerConfig
@@ -161,25 +256,13 @@ class MoEMLP(nn.Module):
         e = moe.num_experts
         tokens = x.reshape(b * s, d)
         t = b * s
-        # Clamp to the token count: capacity_factor*top_k > num_experts
-        # makes the raw capacity exceed T (expert choice's top_k over the
-        # token axis would then be ill-formed; topk slots beyond T can
-        # never fill either).
-        capacity = min(t, max(1, int(moe.capacity_factor * moe.top_k
-                                     * t / e)))
+        capacity = clamped_capacity(t, moe)
 
         router_w = self.param(
             "router", nn.with_logical_partitioning(default_init(),
                                                    ("embed", "expert")),
             (d, e), jnp.float32)
         logits = tokens.astype(jnp.float32) @ router_w
-        if moe.routing == "expert_choice":
-            dispatch, combine, aux = expert_choice_routing(logits, capacity)
-        else:
-            dispatch, combine, aux = top_k_routing(logits, moe.top_k,
-                                                   capacity)
-        for name, val in aux.items():
-            self.sow("intermediates", name, val)
 
         def expert_param(name, shape, axes):
             return self.param(
@@ -190,18 +273,83 @@ class MoEMLP(nn.Module):
         w_up = expert_param("w_up", (e, d, mlp), ("expert", "embed", "mlp"))
         w_down = expert_param("w_down", (e, mlp, d), ("expert", "mlp", "embed"))
 
+        def experts_apply(xe):
+            """[E, C, d] expert buffers -> [E, C, d] outputs."""
+            xe = nn.with_logical_constraint(xe, ("expert", None, "embed"))
+            h = jnp.einsum("ecd,edm->ecm", xe, w_gate)
+            h = nn.silu(h) * jnp.einsum("ecd,edm->ecm", xe, w_up)
+            h = nn.with_logical_constraint(h, ("expert", None, "mlp"))
+            ye = jnp.einsum("ecm,emd->ecd", h, w_down)
+            return nn.with_logical_constraint(ye, ("expert", None, "embed"))
+
+        if moe.dispatch == "index":
+            y, aux = self._index_dispatch(tokens, logits, capacity,
+                                          experts_apply)
+        else:
+            y, aux = self._einsum_dispatch(tokens, logits, capacity,
+                                           experts_apply)
+        for name, val in aux.items():
+            self.sow("intermediates", name, val)
+        return y.reshape(b, s, d)
+
+    def _einsum_dispatch(self, tokens, logits, capacity, experts_apply):
+        """Dense one-hot dispatch/combine (Switch-style reference path)."""
+        cfg, moe = self.cfg, self.moe
+        if moe.routing == "expert_choice":
+            dispatch, combine, aux = expert_choice_routing(logits, capacity)
+        else:
+            dispatch, combine, aux = top_k_routing(logits, moe.top_k,
+                                                   capacity)
         # Dispatch: [T,d] tokens -> [E,C,d] expert buffers (the all-to-all).
         xe = jnp.einsum("tec,td->ecd", dispatch.astype(cfg.dtype),
                         tokens.astype(cfg.dtype))
-        xe = nn.with_logical_constraint(xe, ("expert", None, "embed"))
-        h = jnp.einsum("ecd,edm->ecm", xe, w_gate)
-        h = nn.silu(h) * jnp.einsum("ecd,edm->ecm", xe, w_up)
-        h = nn.with_logical_constraint(h, ("expert", None, "mlp"))
-        ye = jnp.einsum("ecm,emd->ecd", h, w_down)
-        ye = nn.with_logical_constraint(ye, ("expert", None, "embed"))
+        ye = experts_apply(xe)
         # Combine back to token order, weighted by the gates.
         y = jnp.einsum("tec,ecd->td", combine.astype(cfg.dtype), ye)
-        return y.reshape(b, s, d)
+        return y, aux
+
+    def _index_dispatch(self, tokens, logits, capacity, experts_apply):
+        """Index-based scatter/gather dispatch — O(T·k·d) data movement
+        instead of the dense path's T·E·C·d dispatch/combine MACs,
+        identical routing semantics (parity-tested)."""
+        cfg, moe = self.cfg, self.moe
+        t, d = tokens.shape
+        e = moe.num_experts
+        tok_c = tokens.astype(cfg.dtype)
+
+        if moe.routing == "expert_choice":
+            gates, idx = _expert_choice_picks(logits, capacity)   # [E, C]
+            sel = idx.reshape(-1)
+            xe = jnp.take(tok_c, sel, axis=0).reshape(e, capacity, d)
+            ye = experts_apply(xe)
+            y = jnp.zeros((t, d), cfg.dtype).at[sel].add(
+                gates.reshape(-1)[:, None].astype(cfg.dtype)
+                * ye.reshape(e * capacity, d))
+            covered = jnp.zeros((t,), jnp.float32).at[sel].max(1.0)
+            aux = {
+                "router_z_loss": _z_loss(logits),
+                "fraction_dropped": 1.0 - jnp.mean(covered),
+            }
+            return y, aux
+
+        dest, gate, keep, aux = top_k_dispatch_indices(
+            logits, moe.top_k, capacity)
+        # Scatter tokens into [E*C, d] buffers, one scatter per choice (the
+        # operand is `tokens` in place — no gather needed); dropped slots
+        # carry the out-of-range sentinel and fall away via mode="drop".
+        # Slots are unique by construction (one assignment per (e, pos)).
+        xe = jnp.zeros((e * capacity, d), cfg.dtype)
+        for c in range(moe.top_k):
+            xe = xe.at[dest[c]].add(tok_c, mode="drop")
+        ye = experts_apply(xe.reshape(e, capacity, d)).reshape(
+            e * capacity, d)
+        # Combine is a pure gather: dest[c] is already token-indexed.
+        y = jnp.zeros((t, d), cfg.dtype)
+        for c in range(moe.top_k):
+            w = (keep[c] * gate[c])[:, None].astype(cfg.dtype)
+            y = y + jnp.take(ye, jnp.minimum(dest[c], e * capacity - 1),
+                             axis=0) * w
+        return y, aux
 
 
 class MoELM(nn.Module):
@@ -210,6 +358,17 @@ class MoELM(nn.Module):
     Rides the shared :class:`~models.transformer.Transformer` core with
     ``mlp_factory`` swapping the dense MLP for :class:`MoEMLP`, so scan_layers
     / remat / dropout all work for MoE exactly as for dense models.
+
+    .. warning:: ``routing="expert_choice"`` is NON-CAUSAL in this decoder:
+       each expert selects its top-C tokens over the whole flattened [B*S]
+       batch, so position i's routing depends on future tokens (and other
+       batch rows). Training/eval leak future information through the
+       routing decision, and autoregressive decode (which cannot see the
+       future) routes differently from training. Prefer ``routing="topk"``
+       (strictly per-token, causal-safe) for LMs; expert choice fits
+       non-causal models (BERT/ViT-style) — Zhou et al. use it for
+       encoders. A warning is emitted at construction when combined with
+       this causal LM.
     """
 
     cfg: TransformerConfig
@@ -218,6 +377,14 @@ class MoELM(nn.Module):
     @nn.compact
     def __call__(self, tokens, *, positions=None, attention_fn=None,
                  deterministic: bool = True):
+        if self.moe.routing == "expert_choice":
+            warnings.warn(
+                "expert_choice routing inside a causal LM is non-causal: "
+                "experts pick their top-C tokens across the whole batch, "
+                "so routing for position i sees future tokens and decode "
+                "routes differently from training. Use routing='topk' for "
+                "causal LMs (see MoELM docstring).",
+                UserWarning, stacklevel=2)
         factory = functools.partial(MoEMLP, moe=self.moe)
         x = Transformer(self.cfg, mlp_factory=factory, name="transformer")(
             tokens, positions=positions, deterministic=deterministic,
@@ -226,18 +393,27 @@ class MoELM(nn.Module):
 
 
 def flops_per_token(cfg: TransformerConfig, moe: MoEConfig, *,
-                    seq_len: int | None = None) -> float:
+                    seq_len: int | None = None,
+                    tokens_per_batch: int | None = None) -> float:
     """Approximate fwd+bwd FLOPs per token for MFU: the dense transformer
     accounting (:func:`models.transformer.flops_per_token`) with the MLP
-    term scaled by the ACTIVE experts per token — top_k for token-choice
-    routing, capacity_factor·top_k expert-slots/token for expert choice —
-    plus the router matmul. Counts compute actually performed (dispatched
-    slots), not total parameters."""
+    term scaled by the NOMINAL active expert-slots per token — top_k for
+    token-choice routing, capacity_factor·top_k for expert choice — plus
+    the router matmul. Pass ``tokens_per_batch`` (= B*S of the training
+    step) to instead use the exact dispatched-slot count E*C/T with the
+    same int-floor + min(T, ·) capacity clamp MoEMLP applies; without it
+    the nominal figure slightly overstates compute when the clamp binds
+    (small T) and, for topk, ignores capacity-overflow drops."""
     from k8s_distributed_deeplearning_tpu.models import transformer
     dense = transformer.flops_per_token(cfg, seq_len=seq_len)
     mlp_term = 3.0 * 3 * 2 * cfg.dim * cfg.resolved_mlp_dim   # swiglu, x3 fwd+bwd
-    active = (moe.capacity_factor * moe.top_k
-              if moe.routing == "expert_choice" else moe.top_k)
+    if tokens_per_batch is not None:
+        t = tokens_per_batch
+        capacity = clamped_capacity(t, moe)   # the exact MoEMLP formula
+        active = moe.num_experts * capacity / t   # dispatched slots/token
+    else:
+        active = (moe.capacity_factor * moe.top_k
+                  if moe.routing == "expert_choice" else moe.top_k)
     router = 3.0 * 2 * cfg.dim * moe.num_experts
     return dense + cfg.n_layers * (mlp_term * (active - 1) + router)
 

@@ -203,6 +203,80 @@ def test_moe_expert_choice_trains():
     assert np.isfinite(losses).all() and losses[-1] < losses[0], losses
 
 
+@pytest.mark.parametrize("routing", ["topk", "expert_choice"])
+def test_index_dispatch_matches_einsum(routing):
+    """The argsort dispatch must be numerically equivalent to the dense
+    one-hot einsum formulation — same params, same tokens, same output and
+    grads — for both routing policies, including under capacity drops
+    (capacity_factor=1.0 forces overflow)."""
+    import dataclasses
+    cfg = llama.config_tiny(dtype=jnp.float32, n_layers=2, scan_layers=False)
+    mk = lambda dispatch: moe.MoELM(cfg, moe.MoEConfig(
+        num_experts=4, top_k=2, capacity_factor=1.0, routing=routing,
+        dispatch=dispatch))
+    m_sort, m_ein = mk("index"), mk("einsum")
+    tokens = jax.random.randint(jax.random.key(3), (4, 16), 0,
+                                cfg.vocab_size)
+    import warnings as _w
+    with _w.catch_warnings():
+        _w.simplefilter("ignore")  # expert_choice causal warning, expected
+        params = m_sort.init(jax.random.key(1), tokens)["params"]
+        mcfg = moe.MoEConfig(num_experts=4, top_k=2, capacity_factor=1.0,
+                             routing=routing)
+        l_s, a_s = moe.loss_fn(m_sort, mcfg, params, {"tokens": tokens})
+        l_e, a_e = moe.loss_fn(m_ein, mcfg, params, {"tokens": tokens})
+        np.testing.assert_allclose(float(l_s), float(l_e), rtol=2e-5)
+        np.testing.assert_allclose(float(a_s["aux_loss"]),
+                                   float(a_e["aux_loss"]), rtol=2e-5)
+        g_s = jax.grad(lambda p: moe.loss_fn(m_sort, mcfg, p,
+                                             {"tokens": tokens})[0])(params)
+        g_e = jax.grad(lambda p: moe.loss_fn(m_ein, mcfg, p,
+                                             {"tokens": tokens})[0])(params)
+    for (ks_, a), (_, b) in zip(
+            sorted(jax.tree_util.tree_flatten_with_path(g_s)[0],
+                   key=lambda kv: str(kv[0])),
+            sorted(jax.tree_util.tree_flatten_with_path(g_e)[0],
+                   key=lambda kv: str(kv[0]))):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-6,
+                                   err_msg=str(ks_))
+
+
+def test_index_routing_keep_set_matches_einsum():
+    """Property: the index path's keep/drop decisions equal the einsum
+    path's dispatch mask on adversarial logits (everyone wants expert 0),
+    and no buffer slot is double-booked."""
+    t, e, k, cap = 64, 4, 2, 8
+    logits = jnp.zeros((t, e)).at[:, 0].set(3.0)
+    logits = logits + 0.01 * jax.random.normal(jax.random.key(5), (t, e))
+    dispatch, _, _ = moe.top_k_routing(logits, k, cap)
+    dest, gate, keep, _ = moe.top_k_dispatch_indices(logits, k, cap)
+    # Rebuild a [T, E] "token kept in expert" mask from both forms.
+    ein_mask = np.asarray(dispatch).any(axis=2)
+    idx_mask = np.zeros((t, e), bool)
+    dest_np, keep_np = np.asarray(dest), np.asarray(keep)
+    kept_slots = []
+    for c in range(k):
+        for tok in range(t):
+            if keep_np[c, tok]:
+                idx_mask[tok, dest_np[c, tok] // cap] = True
+                kept_slots.append(dest_np[c, tok])
+    np.testing.assert_array_equal(idx_mask, ein_mask)
+    assert len(kept_slots) == len(set(kept_slots))  # slots unique
+
+
+def test_expert_choice_causal_lm_warns():
+    """ADVICE r3 (medium): expert-choice routing in a causal LM leaks
+    future tokens through routing — MoELM must warn loudly."""
+    cfg = llama.config_tiny(dtype=jnp.float32, n_layers=1, scan_layers=False)
+    mcfg = moe.MoEConfig(num_experts=2, top_k=1, capacity_factor=2.0,
+                         routing="expert_choice")
+    model = moe.MoELM(cfg, mcfg)
+    tokens = jnp.zeros((1, 8), jnp.int32)
+    with pytest.warns(UserWarning, match="non-causal"):
+        model.init(jax.random.key(0), tokens)
+
+
 def test_moe_flops_accounting():
     """MoE MFU accounting: active-compute based — expert choice counts
     capacity_factor x top_k expert-slots per token, topk counts top_k; both
@@ -215,6 +289,26 @@ def test_moe_flops_accounting():
         num_experts=4, top_k=2, capacity_factor=1.5,
         routing="expert_choice"))
     assert dense < topk < ec
+
+
+def test_moe_flops_exact_slots_uses_layer_capacity_formula():
+    """tokens_per_batch switches flops_per_token to the EXACT dispatched
+    slot count E*clamped_capacity(T)/T — the same formula MoEMLP sizes its
+    buffers with (ADVICE r3). When the clamp binds (tiny T), the exact
+    figure must fall below nominal; when it doesn't, E*C/T >= top_k (the
+    buffers compute every slot, filled or not)."""
+    mcfg = moe.MoEConfig(num_experts=4, top_k=2, capacity_factor=1.25)
+    cfg = llama.config_tiny(n_layers=2)
+    nominal = moe.flops_per_token(cfg, mcfg)
+    # Unclamped: C = int(1.25*2*T/4), active = 4*C/T = 2.5 > top_k == 2.
+    big = moe.flops_per_token(cfg, mcfg, tokens_per_batch=4096)
+    assert big > nominal
+    cap = moe.clamped_capacity(4096, mcfg)
+    assert cap == int(1.25 * 2 * 4096 / 4)
+    # Clamped: T=2 forces capacity to floor at 1 -> active = 4*1/2 = 2.
+    assert moe.clamped_capacity(2, mcfg) == 1
+    small = moe.flops_per_token(cfg, mcfg, tokens_per_batch=2)
+    assert small < big
 
 
 def test_expert_choice_capacity_exceeding_tokens_clamps():
