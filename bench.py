@@ -263,7 +263,7 @@ def measure_zoo(steps: int = 15, warmup: int = 3) -> dict:
     variables = model.init(jax.random.key(0),
                            jnp.zeros((1, 224, 224, 3)), train=False)
     state = train_zoo.ResNetState(variables["params"],
-                                  variables["batch_stats"],
+                                  variables.get("batch_stats", {}),
                                   opt.init(variables["params"]),
                                   jnp.zeros((), jnp.int32))
     from k8s_distributed_deeplearning_tpu.parallel import data_parallel as dp

@@ -151,7 +151,7 @@ def main(argv: list[str] | None = None) -> dict:
         variables = model.init(rng, jnp.zeros((1, size, size, 3)),
                                train=False)
         variables = dp.replicate(variables, mesh)
-        state = ResNetState(variables["params"], variables["batch_stats"],
+        state = ResNetState(variables["params"], variables.get("batch_stats", {}),
                             optimizer.init(variables["params"]),
                             jnp.zeros((), jnp.int32))
         state = jax.device_put(state, jax.sharding.NamedSharding(mesh, P()))

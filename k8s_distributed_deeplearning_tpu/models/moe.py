@@ -240,7 +240,7 @@ class MoEMLP(nn.Module):
     """Expert-parallel SwiGLU MLP with top-k or expert-choice routing.
 
     Expert weights are [E, ...] with the "expert" logical axis; the
-    dispatch/combine (argsort+scatter by default, dense one-hot einsums
+    dispatch/combine (index/scatter by default, dense one-hot einsums
     with ``dispatch="einsum"``) bridges token-sharding to expert-sharding
     (XLA inserts the collective when the mesh has an expert axis).
     """

@@ -30,6 +30,50 @@ def test_resnet_forward_and_train_step():
     assert all(jnp.isfinite(l).all() for l in jax.tree.leaves(g))
 
 
+def test_resnet_ghost_bn_matches_bn_when_subset_is_full_batch():
+    """GhostBatchNorm with stats_examples >= batch must reproduce exact
+    BatchNorm training output AND the same running-average updates."""
+    x = jax.random.normal(jax.random.key(0), (8, 4, 4, 16))
+    import flax.linen as nn
+    bn = nn.BatchNorm(use_running_average=False, momentum=0.9, epsilon=1e-5,
+                      dtype=jnp.float32)
+    gbn = resnet.GhostBatchNorm(stats_examples=8, use_running_average=False,
+                                momentum=0.9, epsilon=1e-5,
+                                dtype=jnp.float32)
+    vb = bn.init(jax.random.key(1), x)
+    vg = gbn.init(jax.random.key(1), x)
+    yb, ub = bn.apply(vb, x, mutable=["batch_stats"])
+    yg, ug = gbn.apply(vg, x, mutable=["batch_stats"])
+    np.testing.assert_allclose(np.asarray(yg), np.asarray(yb),
+                               rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(
+        np.asarray(ug["batch_stats"]["mean"]),
+        np.asarray(ub["batch_stats"]["mean"]), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(ug["batch_stats"]["var"]),
+        np.asarray(ub["batch_stats"]["var"]), rtol=2e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("norm", ["ghost", "group"])
+def test_resnet_norm_variants_train(norm):
+    """The r4 norm variants (ghost-stats BN, GroupNorm) train end to end:
+    finite loss/grads, eval path runs, GroupNorm has no batch_stats."""
+    model = resnet.resnet18_cifar(norm=norm)
+    x = jax.random.normal(jax.random.key(0), (8, 32, 32, 3))
+    y = jax.random.randint(jax.random.key(1), (8,), 0, 10)
+    variables = model.init(jax.random.key(2), x, train=False)
+    if norm == "group":
+        assert "batch_stats" not in variables
+    loss, aux = resnet.loss_fn(model, variables, {"image": x, "label": y})
+    assert jnp.isfinite(loss)
+    g = jax.grad(lambda p: resnet.loss_fn(
+        model, dict(variables, params=p), {"image": x, "label": y})[0])(
+        variables["params"])
+    assert all(jnp.isfinite(l).all() for l in jax.tree.leaves(g))
+    logits = model.apply(variables, x, train=False)   # eval path
+    assert logits.shape == (8, 10)
+
+
 def test_resnet50_param_count():
     model = resnet.resnet50()
     x = jnp.zeros((1, 224, 224, 3))

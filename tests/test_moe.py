@@ -205,7 +205,7 @@ def test_moe_expert_choice_trains():
 
 @pytest.mark.parametrize("routing", ["topk", "expert_choice"])
 def test_index_dispatch_matches_einsum(routing):
-    """The argsort dispatch must be numerically equivalent to the dense
+    """The index dispatch must be numerically equivalent to the dense
     one-hot einsum formulation — same params, same tokens, same output and
     grads — for both routing policies, including under capacity drops
     (capacity_factor=1.0 forces overflow)."""
