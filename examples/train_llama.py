@@ -105,13 +105,18 @@ def main(argv: list[str] | None = None) -> dict:
                         "scan-stacked layers; composes with --dp only)")
     parser.add_argument("--pp-microbatches", type=int, default=None,
                         help="pipeline microbatches (default: --pp)")
-    parser.add_argument("--pp-schedule", choices=["gpipe", "1f1b"],
+    parser.add_argument("--pp-schedule", choices=["gpipe", "1f1b", "interleaved"],
                         default="gpipe",
                         help="pipeline schedule: gpipe = lowest bubble "
                         "(latency schedule); 1f1b = activation memory "
                         "bounded at min(M, 2P) microbatches (memory "
-                        "schedule — measured 6.5x less temp at M=16, P=4, "
-                        "BENCHMARKS.md)")
+                        "schedule — measured 6.5x less temp at M=16, P=4); "
+                        "interleaved = virtual-stage 1f1b, same memory "
+                        "with a (PV+P-2)/(MV+PV+P-2) bubble — strictly "
+                        "dominates 1f1b (BENCHMARKS.md)")
+    parser.add_argument("--pp-virtual", type=int, default=2,
+                        help="virtual chunks per stage for "
+                        "--pp-schedule interleaved")
     parser.add_argument("--attention",
                         choices=["auto", "xla", "flash", "ring", "ulysses"],
                         default="auto",
@@ -218,7 +223,8 @@ def main(argv: list[str] | None = None) -> dict:
         trainer = pipeline_lm.PipelineTrainer(
             model, optimizer, mesh,
             num_microbatches=args.pp_microbatches or args.pp,
-            chunked_ce=chunked, schedule=args.pp_schedule)
+            chunked_ce=chunked, schedule=args.pp_schedule,
+            num_virtual=args.pp_virtual)
         loss = trainer.loss_fn
         state = trainer.init(init, jax.random.key(conf.seed))
         step_fn = trainer.make_step(donate=True)

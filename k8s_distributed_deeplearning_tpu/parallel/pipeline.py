@@ -304,6 +304,201 @@ def pipeline_value_and_grad_1f1b(
     return loss, aux, g_blocks, g_head, dx.reshape(b, *out0.shape[1:])
 
 
+def pipeline_value_and_grad_interleaved(
+        block_fn: Callable, loss_mb_fn: Callable, chunk_params: PyTree,
+        head_params: PyTree, x: jax.Array, loss_aux: PyTree, *,
+        num_microbatches: int, num_virtual: int,
+        axis_name: str = "pipeline",
+        extras: PyTree = None, rng: jax.Array | None = None,
+        reduce_axes: tuple[str, ...] = ()) -> tuple:
+    """Interleaved-virtual-stage 1F1B (Megatron-style chunk placement) —
+    call inside ``shard_map``.
+
+    Each device holds ``V = num_virtual`` NON-contiguous layer chunks:
+    chunk ``c = q·P + d`` lives on device ``d`` (*chunk_params* leaves are
+    ``[V, L_chunk, ...]``, row q = chunk qP+d). A microbatch traverses
+    P·V chunk-stages, hopping devices through ONE circular ppermute per
+    tick — the wrap from device P-1 back to device 0 carries the
+    activation from chunk qP+P-1 to chunk (q+1)P, and the uniform slot
+    arithmetic makes it arrive exactly one tick before it is consumed:
+
+    - forward slot of device d at tick t is slot-line ``s = t - d`` with
+      chunk ``q = (s // P) mod V`` and microbatch
+      ``i = (s // (P·V))·P + s % P`` (microbatches in groups of P — M must
+      divide by P);
+    - backward mirrors it with lag P·V: ``u = t - (P-1-d) - P·V``,
+      ``q = V-1 - (u // P) mod V``, reverse circular ppermute;
+    - the head/loss runs under ``lax.cond`` and only computes on ticks
+      whose forward slot completed the FINAL chunk on the last device —
+      not on every stage every tick (the r3 1F1B paid the head matmul
+      unconditionally).
+
+    Versus the plain uniform 1F1B: ticks are CHUNK-sized (1/V of a stage),
+    so the drain shrinks — total ticks M·V + P·V + P - 1 of work 1/V each,
+    i.e. bubble fraction (PV + P - 2)/(MV + PV + P - 2) vs (2P-1)/(M+2P-1)
+    (at P=4, M=16, V=2: 0.238 vs 0.304), at the same O(P) activation
+    memory (ring of min(MV, 2PV) chunk-inputs = the 1F1B bound). GPipe's
+    (P-1)/(M+P-1) latency bubble remains lower at O(M) memory; a fully
+    Megatron-style non-uniform warmup (double-rate forward ticks) would
+    close that too but breaks the uniform-tick chunk-wrap timing —
+    measured trade recorded in BENCHMARKS.md.
+
+    Same contract as :func:`pipeline_value_and_grad_1f1b` otherwise;
+    returns ``(loss, aux_scalars, grads_chunks [V, L_chunk, ...],
+    grads_head, dx)``.
+    """
+    p = lax.axis_size(axis_name)
+    stage = lax.axis_index(axis_name)
+    m, v = num_microbatches, num_virtual
+    b = x.shape[0]
+    if b % m:
+        raise ValueError(f"batch {b} not divisible by {m} microbatches")
+    if m % p:
+        raise ValueError(
+            f"interleaved schedule needs microbatches ({m}) divisible by "
+            f"pipeline stages ({p}) — microbatches run in groups of P")
+    mb = b // m
+    micro = x.reshape(m, mb, *x.shape[1:])
+    micro_aux = jax.tree.map(lambda a: a.reshape(m, mb, *a.shape[1:]),
+                             loss_aux)
+    micro_extras = (None if extras is None else jax.tree.map(
+        lambda a: a.reshape(m, mb, *a.shape[1:]), extras))
+    n_local = jax.tree_util.tree_leaves(chunk_params)[0].shape[1]
+    mv, pv = m * v, p * v
+    k_slots = min(mv, 2 * pv)       # chunk-input ring (see docstring)
+
+    def chunk_at(q):
+        return jax.tree.map(
+            lambda a: lax.dynamic_index_in_dim(a, q, 0, keepdims=False),
+            chunk_params)
+
+    def chunk_fwd(params_, inp, ex, r, q):
+        return _apply_local_stack(block_fn, params_, inp, ex, r,
+                                  (q * p + stage) * n_local)
+
+    def slice_tree(tree, i):
+        return (None if tree is None else jax.tree.map(
+            lambda a: lax.dynamic_index_in_dim(a, i, 0, keepdims=False),
+            tree))
+
+    i0 = jnp.zeros((), jnp.int32)
+    out0 = jax.eval_shape(
+        functools.partial(chunk_fwd, ex=slice_tree(micro_extras, i0),
+                          r=rng, q=i0),
+        chunk_at(i0), jax.ShapeDtypeStruct((mb, *x.shape[1:]), x.dtype))
+    fwd_shift = [(i, (i + 1) % p) for i in range(p)]     # circular
+    bwd_shift = [(i, (i - 1) % p) for i in range(p)]     # reverse circular
+    zeros_like_tree = functools.partial(jax.tree.map,
+                                        lambda a: jnp.zeros(a.shape, a.dtype))
+
+    aux0 = jax.eval_shape(
+        lambda: loss_mb_fn(head_params,
+                           jnp.zeros(out0.shape, out0.dtype),
+                           slice_tree(micro_aux, i0))[1])
+
+    def head_slot(hp, y, aux_i):
+        loss_i, head_vjp, metrics_i = jax.vjp(
+            lambda hp_, y_: loss_mb_fn(hp_, y_, aux_i), hp, y,
+            has_aux=True)
+        dhead_i, dy_i = head_vjp(jnp.ones((), loss_i.dtype))
+        return (loss_i, metrics_i, dhead_i, dy_i.astype(out0.dtype))
+
+    def head_zeros(hp, y, aux_i):
+        return (jnp.zeros((), jnp.float32),
+                jax.tree.map(lambda a: jnp.zeros(a.shape, a.dtype), aux0),
+                zeros_like_tree(hp),
+                jnp.zeros(out0.shape, out0.dtype))
+
+    def tick(carry, t):
+        (fwd_cur, pending_dy, bwd_cur, act_buf, g_chunks, g_head,
+         loss_acc, aux_acc, dx_out) = carry
+
+        # ---- forward slot: slot-line s = t - stage --------------------
+        s = t - stage
+        s_c = jnp.clip(s, 0, mv - 1)
+        fwd_valid = (s >= 0) & (s < mv)
+        q = (s_c // p) % v
+        i = (s_c // pv) * p + (s_c % p)
+        inject = lax.dynamic_index_in_dim(micro, i, 0, keepdims=False)
+        x_in = jnp.where((stage == 0) & (q == 0),
+                         inject.astype(out0.dtype), fwd_cur)
+        ex_i = slice_tree(micro_extras, i)
+        r_i = None if rng is None else jax.random.fold_in(rng, i)
+        y = chunk_fwd(chunk_at(q), x_in, ex_i, r_i, q)
+        upd = lax.dynamic_update_index_in_dim(act_buf, x_in,
+                                              s_c % k_slots, 0)
+        act_buf = jnp.where(fwd_valid, upd, act_buf)
+        nxt_fwd = lax.ppermute(y, axis_name, fwd_shift)
+
+        # ---- head slot: only when the FINAL chunk just finished -------
+        head_valid = fwd_valid & (stage == p - 1) & (q == v - 1)
+        aux_i = slice_tree(micro_aux, i)
+        loss_i, metrics_i, dhead_i, dy_i = lax.cond(
+            head_valid, head_slot, head_zeros, head_params, y, aux_i)
+        loss_acc = loss_acc + loss_i            # zero when not head slot
+        aux_acc = jax.tree.map(jnp.add, aux_acc, metrics_i)
+        g_head = jax.tree.map(jnp.add, g_head, dhead_i)
+
+        # ---- backward slot: u = t - (p-1-stage) - p*v -----------------
+        u = t - (p - 1 - stage) - pv
+        u_c = jnp.clip(u, 0, mv - 1)
+        bwd_valid = (u >= 0) & (u < mv)
+        bq = v - 1 - (u_c // p) % v             # chunk being backpropped
+        ib = (u_c // pv) * p + (u_c % p)
+        dy = jnp.where((stage == p - 1) & (bq == v - 1), pending_dy,
+                       bwd_cur)
+        s_fwd = (u_c // pv) * pv + bq * p + (u_c % p)   # matching fwd slot
+        x_saved = lax.dynamic_index_in_dim(act_buf, s_fwd % k_slots, 0,
+                                           keepdims=False)
+        ex_j = slice_tree(micro_extras, ib)
+        r_j = None if rng is None else jax.random.fold_in(rng, ib)
+        _, chunk_vjp = jax.vjp(
+            lambda pr, xi: chunk_fwd(pr, xi, ex_j, r_j, bq),
+            chunk_at(bq), x_saved)
+        dparams_j, dx_j = chunk_vjp(dy.astype(out0.dtype))
+        g_chunks = jax.tree.map(
+            lambda g, d: g.at[bq].add(jnp.where(bwd_valid, d, 0)),
+            g_chunks, dparams_j)
+        nxt_bwd = lax.ppermute(dx_j, axis_name, bwd_shift)
+        # Chunk 0 on device 0 produces the embedding cotangent.
+        upd_dx = lax.dynamic_update_index_in_dim(dx_out, dx_j, ib, 0)
+        dx_out = jnp.where(bwd_valid & (stage == 0) & (bq == 0),
+                           upd_dx, dx_out)
+
+        return (nxt_fwd, dy_i, nxt_bwd, act_buf, g_chunks, g_head,
+                loss_acc, aux_acc, dx_out), None
+
+    carry0 = (
+        jnp.zeros(out0.shape, out0.dtype),                  # fwd_cur
+        jnp.zeros(out0.shape, out0.dtype),                  # pending_dy
+        jnp.zeros(out0.shape, out0.dtype),                  # bwd_cur
+        jnp.zeros((k_slots, *out0.shape), out0.dtype),      # act ring
+        zeros_like_tree(chunk_params),                      # chunk grads
+        zeros_like_tree(head_params),                       # head grads
+        jnp.zeros((), jnp.float32),                         # loss
+        jax.tree.map(lambda a: jnp.zeros(a.shape, a.dtype), aux0),
+        jnp.zeros((m, *out0.shape), out0.dtype),            # dx per mb
+    )
+    (_, _, _, _, g_chunks, g_head, loss, aux, dx_out), _ = lax.scan(
+        tick, carry0, jnp.arange(mv + pv + p - 1))
+
+    # loss/head grads are real on the last stage, dx on stage 0: rebroadcast.
+    last = stage == p - 1
+    loss = lax.psum(jnp.where(last, loss, 0.0), axis_name)
+    aux = jax.tree.map(
+        lambda a: lax.psum(jnp.where(last, a, 0.0), axis_name), aux)
+    g_head = jax.tree.map(
+        lambda g: lax.psum(jnp.where(last, g, 0), axis_name), g_head)
+    dx = lax.psum(jnp.where(stage == 0, dx_out, 0), axis_name)
+    for ax in reduce_axes:
+        loss = lax.psum(loss, ax)
+        aux = jax.tree.map(lambda a: lax.psum(a, ax), aux)
+        g_head = jax.tree.map(lambda g: lax.psum(g, ax), g_head)
+        g_chunks = jax.tree.map(lambda g: lax.psum(g, ax), g_chunks)
+        # dx stays batch-local: its batch dim is sharded over the data axis.
+    return loss, aux, g_chunks, g_head, dx.reshape(b, *out0.shape[1:])
+
+
 def pipeline_loss(per_example_loss: Callable, axis_name: str = "pipeline"):
     """Wrap a loss over pipeline outputs so each stage computes it and the
     pmean makes value and gradients exact (see module docstring)."""

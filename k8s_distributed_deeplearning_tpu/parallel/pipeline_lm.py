@@ -190,10 +190,20 @@ class PipelineTrainer:
     - ``"gpipe"`` (default): forward schedule + autodiff transpose. Stores
       one activation per microbatch per stage before backward starts
       (O(M) memory); bubble (P-1)/(M+P-1) — the latency schedule.
-    - ``"1f1b"``: interleaved one-forward-one-backward
+    - ``"1f1b"``: one-forward-one-backward
       (:func:`parallel.pipeline.pipeline_value_and_grad_1f1b`). Activation
       ring buffer bounded at min(M, 2P) entries (O(P) — the memory
       schedule, for M >> P); uniform-tick bubble (2P-1)/(M+2P-1).
+    - ``"interleaved"``: virtual-stage 1F1B
+      (:func:`parallel.pipeline.pipeline_value_and_grad_interleaved`):
+      each device holds ``num_virtual`` non-contiguous layer chunks, the
+      head/loss computes only on head slots, bubble
+      (PV+P-2)/(MV+PV+P-2) at the same O(P) memory. Needs
+      ``num_microbatches % stages == 0`` and
+      ``n_layers % (stages * num_virtual) == 0``. The TrainState stores
+      block weights chunk-arranged as ``[V, P, L/(P·V), ...]`` (a free
+      reshape of the natural layer stack) so each device holds exactly
+      its chunks with no per-step resharding.
 
     Mesh must carry *axis_name* (pipeline stages; must divide
     ``cfg.n_layers``) and may carry *data_axes* (batch sharding). Other
@@ -206,21 +216,34 @@ class PipelineTrainer:
                  axis_name: str = "pipeline",
                  data_axes: tuple[str, ...] = ("data",),
                  chunked_ce: bool = False, chunk_size: int = 1024,
-                 schedule: str = "gpipe"):
+                 schedule: str = "gpipe", num_virtual: int = 2):
         cfg = model.cfg
         _check_supported(cfg)
-        if schedule not in ("gpipe", "1f1b"):
-            raise ValueError(f"schedule must be 'gpipe' or '1f1b', "
-                             f"got {schedule!r}")
-        if schedule == "1f1b" and cfg.position == "learned":
+        if schedule not in ("gpipe", "1f1b", "interleaved"):
+            raise ValueError(f"schedule must be 'gpipe', '1f1b' or "
+                             f"'interleaved', got {schedule!r}")
+        if schedule in ("1f1b", "interleaved") and cfg.position == "learned":
             raise NotImplementedError(
-                "1f1b owns the embedding backward and supports rope/none "
-                "positions only")
+                f"{schedule} owns the embedding backward and supports "
+                "rope/none positions only")
         stages = mesh.shape[axis_name]
         if cfg.n_layers % stages:
             raise ValueError(
                 f"n_layers={cfg.n_layers} must divide evenly into "
                 f"{stages} pipeline stages")
+        if schedule == "interleaved":
+            if num_virtual < 1:
+                raise ValueError(f"num_virtual must be >= 1, "
+                                 f"got {num_virtual}")
+            if cfg.n_layers % (stages * num_virtual):
+                raise ValueError(
+                    f"n_layers={cfg.n_layers} must divide into "
+                    f"{stages} stages x {num_virtual} virtual chunks")
+            if num_microbatches % stages:
+                raise ValueError(
+                    f"interleaved schedule needs num_microbatches "
+                    f"({num_microbatches}) divisible by stages ({stages})")
+        self.num_virtual = num_virtual if schedule == "interleaved" else 1
         self.model = model
         self.optimizer = optimizer
         self.mesh = mesh
@@ -241,8 +264,31 @@ class PipelineTrainer:
     def _spec_for_path(self, path) -> P:
         keys = [getattr(k, "key", getattr(k, "name", None)) for k in path]
         if "blocks" in keys:
+            if self.schedule == "interleaved":
+                # [V, P, L/(PV), ...]: shard the device dim.
+                return P(None, self.axis_name)
             return P(self.axis_name)     # stacked layer axis -> stage shard
         return P()
+
+    def _chunk_blocks(self, params: PyTree) -> PyTree:
+        """Natural [L, ...] block leaves -> chunk-arranged [V, P, L/(PV),
+        ...] (free reshape: layer (q*P+d)*nl + k is element [q, d, k])."""
+        v, p = self.num_virtual, self.mesh.shape[self.axis_name]
+
+        def reshape(a):
+            return a.reshape(v, p, a.shape[0] // (v * p), *a.shape[1:])
+        blocks = jax.tree.map(reshape, params["transformer"]["blocks"])
+        return {**params, "transformer": {**params["transformer"],
+                                          "blocks": blocks}}
+
+    def _natural_blocks(self, params: PyTree) -> PyTree:
+        """Inverse of :meth:`_chunk_blocks` (for the eval/gpipe paths)."""
+        def reshape(a):
+            return a.reshape(a.shape[0] * a.shape[1] * a.shape[2],
+                             *a.shape[3:])
+        blocks = jax.tree.map(reshape, params["transformer"]["blocks"])
+        return {**params, "transformer": {**params["transformer"],
+                                          "blocks": blocks}}
 
     def state_shardings(self, abstract_state: PyTree) -> PyTree:
         def one(path, leaf):
@@ -259,6 +305,8 @@ class PipelineTrainer:
 
         def make_state(r):
             params = nn.meta.unbox(init_params_fn(r))
+            if self.schedule == "interleaved":
+                params = self._chunk_blocks(params)
             return TrainState(params=params,
                               opt_state=self.optimizer.init(params),
                               step=jnp.zeros((), jnp.int32))
@@ -281,6 +329,10 @@ class PipelineTrainer:
         from k8s_distributed_deeplearning_tpu.models.llama import unembedding
 
         _check_supported(self.model.cfg, batch)
+        if self.schedule == "interleaved":
+            # Eval path runs the contiguous-stage forward: back to the
+            # natural layer stack (free reshape; resharding is eval-only).
+            params = self._natural_blocks(nn.meta.unbox(params))
         # Only thread the rng through the schedule when the model actually
         # has stochastic layers — a live rng switches the pipeline to its
         # stochastic compiled variant.
@@ -305,33 +357,18 @@ class PipelineTrainer:
                / jnp.maximum(mask.sum(), 1.0))
         return loss, {"accuracy": acc, "perplexity": jnp.exp(loss)}
 
-    # -- 1f1b engine -------------------------------------------------------
-    def _value_and_grad_1f1b(self, params, batch, rng=None):
-        """Loss + full param gradients through the interleaved 1F1B
-        schedule. The schedule owns embedding forward/backward and the
-        head-side loss; gradients are reassembled into the params tree."""
-        import flax.linen as nn
-        from k8s_distributed_deeplearning_tpu.models.llama import unembedding
-
+    # -- schedule-owned loss/grad plumbing (shared by 1f1b + interleaved) --
+    def _make_loss_mb_fn(self, layout):
+        """Per-microbatch loss CONTRIBUTION ``(hp, y_mb, aux_mb, tm) ->
+        (scalar, metrics)``: (ce*mask).sum()/tm and the weighted-correct
+        count /tm, so contributions sum to exactly the batch loss/accuracy
+        (tm = the global mask count, known before the schedule runs). ONE
+        definition for both schedule engines so they cannot drift."""
         cfg = self.model.cfg
-        _check_supported(cfg, batch)
-        if not cfg.dropout_rate:
-            rng = None
-        params = nn.meta.unbox(params)
-        inputs, targets, seg_in, mask = _prepare_lm_batch(batch)
-        total_mask = jnp.maximum(mask.sum(), 1.0)   # known pre-schedule
-
-        tp = params["transformer"]
-        w, layout = unembedding(cfg, params)
-        head_side = {"final_norm": tp["final_norm"], "unembed": w}
         norm = tfm.make_norm(cfg, None)
         chunked, chunk_size = self.chunked_ce, self.chunk_size
 
         def loss_mb_fn(hp, y_mb, aux_mb, tm):
-            # Per-microbatch CONTRIBUTIONS: (ce*mask).sum()/tm and the
-            # weighted-correct count /tm, so contributions sum to exactly
-            # the batch loss/accuracy (tm = the global mask count, known
-            # before the schedule runs).
             x = norm.apply({"params": hp["final_norm"]}, y_mb)
             mb_mask = aux_mb["mask"]
             if chunked:
@@ -351,7 +388,43 @@ class PipelineTrainer:
                        * mb_mask).sum()
             return ((ce * mb_mask).sum() / tm,
                     {"accuracy": correct / tm})
+        return loss_mb_fn
 
+    def _assemble_grads(self, inputs, dx, g_blocks, g_head, emb):
+        """Schedule outputs -> full params-tree gradients (embedding
+        scatter + tied-weight fold). Shared by both schedule engines."""
+        cfg = self.model.cfg
+        g_emb = jnp.zeros(emb.shape, emb.dtype).at[inputs].add(
+            dx.astype(emb.dtype))
+        if cfg.tie_embeddings:
+            g_emb = g_emb + g_head["unembed"].astype(emb.dtype)
+        grads = {"transformer": {"tok_embed": {"embedding": g_emb},
+                                 "blocks": g_blocks,
+                                 "final_norm": g_head["final_norm"]}}
+        if not cfg.tie_embeddings:
+            grads["head"] = {"lm_head": {"kernel": g_head["unembed"]}}
+        return grads
+
+    # -- 1f1b engine -------------------------------------------------------
+    def _value_and_grad_1f1b(self, params, batch, rng=None):
+        """Loss + full param gradients through the interleaved 1F1B
+        schedule. The schedule owns embedding forward/backward and the
+        head-side loss; gradients are reassembled into the params tree."""
+        import flax.linen as nn
+        from k8s_distributed_deeplearning_tpu.models.llama import unembedding
+
+        cfg = self.model.cfg
+        _check_supported(cfg, batch)
+        if not cfg.dropout_rate:
+            rng = None
+        params = nn.meta.unbox(params)
+        inputs, targets, seg_in, mask = _prepare_lm_batch(batch)
+        total_mask = jnp.maximum(mask.sum(), 1.0)   # known pre-schedule
+
+        tp = params["transformer"]
+        w, layout = unembedding(cfg, params)
+        head_side = {"final_norm": tp["final_norm"], "unembed": w}
+        loss_mb_fn = self._make_loss_mb_fn(layout)
         block_fn = block_fn_from_config(cfg)
         packed = seg_in is not None
         stochastic = rng is not None
@@ -392,16 +465,75 @@ class PipelineTrainer:
             args.append(rng)
         loss, metrics, g_blocks, g_head, dx = sharded(*args)
 
-        # Embedding backward (the schedule returns the input cotangent).
-        g_emb = jnp.zeros(emb.shape, emb.dtype).at[inputs].add(
-            dx.astype(emb.dtype))
-        if cfg.tie_embeddings:
-            g_emb = g_emb + g_head["unembed"].astype(emb.dtype)
-        grads = {"transformer": {"tok_embed": {"embedding": g_emb},
-                                 "blocks": g_blocks,
-                                 "final_norm": g_head["final_norm"]}}
-        if not cfg.tie_embeddings:
-            grads["head"] = {"lm_head": {"kernel": g_head["unembed"]}}
+        grads = self._assemble_grads(inputs, dx, g_blocks, g_head, emb)
+        return loss, {"accuracy": metrics["accuracy"],
+                      "perplexity": jnp.exp(loss)}, grads
+
+    def _value_and_grad_interleaved(self, params, batch, rng=None):
+        """Loss + gradients through the interleaved-virtual-stage schedule
+        (same ownership contract as :meth:`_value_and_grad_1f1b`; block
+        weights and their grads are chunk-arranged [V, P, L/(PV), ...])."""
+        import flax.linen as nn
+        from k8s_distributed_deeplearning_tpu.models.llama import unembedding
+
+        cfg = self.model.cfg
+        _check_supported(cfg, batch)
+        if not cfg.dropout_rate:
+            rng = None
+        params = nn.meta.unbox(params)
+        inputs, targets, seg_in, mask = _prepare_lm_batch(batch)
+        total_mask = jnp.maximum(mask.sum(), 1.0)   # known pre-schedule
+
+        tp = params["transformer"]
+        w, layout = unembedding(cfg, params)
+        head_side = {"final_norm": tp["final_norm"], "unembed": w}
+        loss_mb_fn = self._make_loss_mb_fn(layout)
+        block_fn = block_fn_from_config(cfg)
+        packed = seg_in is not None
+        stochastic = rng is not None
+        axis, m, v = self.axis_name, self.num_microbatches, self.num_virtual
+        blocks_spec = P(None, axis)       # [V, P, nl, ...]: shard dim 1
+        xspec = P(self.data_axes or None)
+        in_specs = [blocks_spec, P(), xspec, xspec, P()]
+        if packed:
+            in_specs.append(xspec)
+        if stochastic:
+            in_specs.append(P())
+
+        def inner(blocks, head, x, aux, tm, *rest):
+            rest = list(rest)
+            extras = rest.pop(0) if packed else None
+            r = rest.pop(0) if stochastic else None
+            # Local view [V, 1, nl, ...] -> [V, nl, ...].
+            local = jax.tree.map(lambda a: a.squeeze(1), blocks)
+            out = pipeline.pipeline_value_and_grad_interleaved(
+                block_fn,
+                lambda hp, y, a: loss_mb_fn(hp, y, a, tm),
+                local, head, x, aux,
+                num_microbatches=m, num_virtual=v, axis_name=axis,
+                extras=extras, rng=r, reduce_axes=self.data_axes)
+            loss, auxs, g_chunks, g_head, dx = out
+            g_chunks = jax.tree.map(lambda a: a[:, None], g_chunks)
+            return loss, auxs, g_chunks, g_head, dx
+
+        sharded = jax.shard_map(
+            inner, mesh=self.mesh,
+            in_specs=tuple(in_specs),
+            out_specs=(P(), P(), blocks_spec, P(), xspec),
+            check_vma=False)
+
+        emb = tp["tok_embed"]["embedding"]
+        x = jnp.take(emb, inputs, axis=0).astype(cfg.dtype)
+        aux_tree = {"targets": targets, "mask": mask}
+        args = [tp["blocks"], head_side, x, aux_tree, total_mask]
+        if packed:
+            args.append({"segment_ids": seg_in,
+                         "positions": tfm.packed_positions(seg_in)})
+        if stochastic:
+            args.append(rng)
+        loss, metrics, g_blocks, g_head, dx = sharded(*args)
+
+        grads = self._assemble_grads(inputs, dx, g_blocks, g_head, emb)
         return loss, {"accuracy": metrics["accuracy"],
                       "perplexity": jnp.exp(loss)}, grads
 
@@ -409,7 +541,10 @@ class PipelineTrainer:
         opt = self.optimizer
 
         def step(state: TrainState, batch: PyTree, rng: jax.Array):
-            if self.schedule == "1f1b":
+            if self.schedule == "interleaved":
+                loss, aux, grads = self._value_and_grad_interleaved(
+                    state.params, batch, rng)
+            elif self.schedule == "1f1b":
                 loss, aux, grads = self._value_and_grad_1f1b(
                     state.params, batch, rng)
             else:
@@ -425,6 +560,8 @@ class PipelineTrainer:
     def value_and_grad(self, params, batch, rng=None):
         """(loss, aux, grads) through the configured schedule — the 1f1b
         parity-test surface (gpipe goes through autodiff)."""
+        if self.schedule == "interleaved":
+            return self._value_and_grad_interleaved(params, batch, rng)
         if self.schedule == "1f1b":
             return self._value_and_grad_1f1b(params, batch, rng)
         (loss, aux), grads = jax.value_and_grad(
