@@ -112,7 +112,7 @@ def main(argv: list[str] | None = None) -> dict:
                         "bounded at min(M, 2P) microbatches (memory "
                         "schedule — measured 6.5x less temp at M=16, P=4); "
                         "interleaved = virtual-stage 1f1b, same memory "
-                        "with a (PV+P-2)/(MV+PV+P-2) bubble — strictly "
+                        "with a (PV+P-1)/(MV+PV+P-1) bubble — strictly "
                         "dominates 1f1b (BENCHMARKS.md)")
     parser.add_argument("--pp-virtual", type=int, default=2,
                         help="virtual chunks per stage for "
@@ -258,9 +258,6 @@ def main(argv: list[str] | None = None) -> dict:
             f"{topo.num_processes} processes")
     per_host = global_batch // topo.num_processes
     if args.pack:
-        if use_cp:
-            raise ValueError("--pack (segment ids) is not supported with "
-                             "context-parallel attention yet")
         docs = data_lib.split_documents(tokens, args.pack_sep_id,
                                         seed=conf.seed)
         batcher = data_lib.PackedTokenBatcher(

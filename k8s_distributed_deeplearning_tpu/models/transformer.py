@@ -311,11 +311,13 @@ class Attention(nn.Module):
             k = nn.with_logical_constraint(k, ("batch", "seq", "kv", "head_dim"))
             v = nn.with_logical_constraint(v, ("batch", "seq", "kv", "head_dim"))
             if attention_fn is not None:
-                if segment_ids is not None:
-                    raise NotImplementedError(
-                        "segment_ids with a custom attention_fn "
-                        "(context-parallel) is not supported yet")
-                out = attention_fn(q, k, v, causal=cfg.causal, mask=mask)
+                # Packed sequences compose with context-parallel attention:
+                # the CP wrappers accept segment_ids (ring rotates the
+                # K-side ids with their shard; Ulysses all-gathers them).
+                kw = {} if segment_ids is None else {
+                    "segment_ids": segment_ids}
+                out = attention_fn(q, k, v, causal=cfg.causal, mask=mask,
+                                   **kw)
             else:
                 out = attention_ops.multi_head_attention(
                     q, k, v, causal=cfg.causal, mask=mask,

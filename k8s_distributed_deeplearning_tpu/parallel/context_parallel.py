@@ -47,14 +47,15 @@ NEG_INF = -1e30  # large-but-finite: avoids inf-inf NaNs in online softmax
 def _block_attend(q, k, v, mask, softmax_scale):
     """One blockwise attention step -> (block_out, block_rowsum, block_rowmax).
 
-    q: [B,Sq,H,D]; k/v: [B,Sk,H,D]; mask: [Sq,Sk] bool or None.
-    Returns f32 (o_block unnormalized, l row-sums, m row-maxes) per flash
-    attention: softmax deferred until all blocks are merged.
+    q: [B,Sq,H,D]; k/v: [B,Sk,H,D]; mask: [Sq,Sk] or [B,Sq,Sk] bool or
+    None. Returns f32 (o_block unnormalized, l row-sums, m row-maxes) per
+    flash attention: softmax deferred until all blocks are merged.
     """
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
                    preferred_element_type=jnp.float32) * softmax_scale
     if mask is not None:
-        s = jnp.where(mask[None, None], s, NEG_INF)
+        m_ = mask[None, None] if mask.ndim == 2 else mask[:, None]
+        s = jnp.where(m_, s, NEG_INF)
     m = jnp.max(s, axis=-1)                        # [B,H,Sq]
     p = jnp.exp(s - m[..., None])
     p = jnp.where(s <= NEG_INF / 2, 0.0, p)        # fully-masked rows -> 0
@@ -68,10 +69,17 @@ def _repeat_kv(x, n_rep):
     return x if n_rep == 1 else jnp.repeat(x, n_rep, axis=2)
 
 
-def _ring_fwd_loop(q, k, v, axis_name, causal, scale):
+def _ring_fwd_loop(q, k, v, axis_name, causal, scale,
+                   segq=None, segk=None):
     """The forward rotation loop -> (out [B,Sq,H,D] in q.dtype,
     lse [B,H,Sq] f32). lse = m + log(l) is the flash-attention
     log-normalizer the backward uses to recompute every P block.
+
+    Packed sequences: *segq*/*segk* ([B, S_local] int32 shards) restrict
+    attention to equal segment ids — the K-side ids RIDE THE ROTATION with
+    their K/V shard, and each block's mask is causal ∧ segment-equal
+    inside the online-softmax accumulate. Fully-masked blocks contribute
+    exact zeros (the NEG_INF guard in _block_attend).
 
     Written as ``lax.scan`` over the ring steps so per-step score blocks are
     provably reused (unrolling let the scheduler keep ~2 [B,H,Sq,Sk]
@@ -86,70 +94,87 @@ def _ring_fwd_loop(q, k, v, axis_name, causal, scale):
     g_rep = hq // hkv
     sq, sk = q.shape[1], k.shape[1]
     b, h = q.shape[0], hq
+    segments = segq is not None
 
     row = lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
     col = lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
     shift_perm = [(i, (i - 1) % n) for i in range(n)]
 
-    def step(carry, t):
-        o, l, m, k, v = carry
-        # Rotation sends shard i to i-1, so at step t we hold rank (r+t)%n's KV.
-        src = (r + t) % n
+    def block_mask(src, segk_t):
+        mask = None
         if causal:
             # Global positions: queries r*sq + row, keys src*sk + col.
             mask = (r * sq + row) >= (src * sk + col)
-        else:
-            mask = None
+        if segments:
+            seg_eq = segq[:, :, None] == segk_t[:, None, :]   # [B,Sq,Sk]
+            mask = seg_eq if mask is None else seg_eq & mask[None]
+        return mask
+
+    def step(carry, t):
+        o, l, m, k, v, segk_t = carry
+        # Rotation sends shard i to i-1, so at step t we hold rank (r+t)%n's KV.
+        src = (r + t) % n
         bo, bl, bm = _block_attend(q, _repeat_kv(k, g_rep),
-                                   _repeat_kv(v, g_rep), mask, scale)
+                                   _repeat_kv(v, g_rep),
+                                   block_mask(src, segk_t), scale)
         m_new = jnp.maximum(m, bm)
         alpha = jnp.exp(m - m_new)        # rescale old accumulator
         beta = jnp.exp(bm - m_new)        # rescale incoming block
         l = alpha * l + beta * bl
         o = (alpha.transpose(0, 2, 1)[..., None] * o
              + beta.transpose(0, 2, 1)[..., None] * bo)
-        # Rotate KV to the next ring position (the final rotation brings
-        # them home — one redundant hop in exchange for a uniform body).
+        # Rotate KV (and its segment ids) to the next ring position (the
+        # final rotation brings them home — one redundant hop in exchange
+        # for a uniform body).
         k = lax.ppermute(k, axis_name, shift_perm)
         v = lax.ppermute(v, axis_name, shift_perm)
-        return (o, l, m_new, k, v), None
+        if segments:
+            segk_t = lax.ppermute(segk_t, axis_name, shift_perm)
+        return (o, l, m_new, k, v, segk_t), None
 
     o0 = jnp.zeros((b, sq, h, q.shape[-1]), jnp.float32)
     l0 = jnp.zeros((b, h, sq), jnp.float32)
     m0 = jnp.full((b, h, sq), NEG_INF, jnp.float32)
-    (o, l, m, _, _), _ = lax.scan(step, (o0, l0, m0, k, v), jnp.arange(n))
+    segk0 = segk if segments else jnp.zeros((), jnp.int32)
+    (o, l, m, _, _, _), _ = lax.scan(step, (o0, l0, m0, k, v, segk0),
+                                     jnp.arange(n))
 
+    # Fully-masked rows (all-pad rows under segment masking with causal
+    # off never occur in practice; with causal on, a row always sees its
+    # own position) still guard via the l floor below.
     norm = jnp.maximum(l, 1e-30)
     out = (o / norm.transpose(0, 2, 1)[..., None]).astype(q.dtype)
     return out, m + jnp.log(norm)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _ring(q, k, v, axis_name, causal, scale):
-    return _ring_fwd_loop(q, k, v, axis_name, causal, scale)[0]
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
+def _ring(q, k, v, segq, segk, axis_name, causal, scale):
+    return _ring_fwd_loop(q, k, v, axis_name, causal, scale, segq, segk)[0]
 
 
-def _ring_vjp_fwd(q, k, v, axis_name, causal, scale):
-    out, lse = _ring_fwd_loop(q, k, v, axis_name, causal, scale)
+def _ring_vjp_fwd(q, k, v, segq, segk, axis_name, causal, scale):
+    out, lse = _ring_fwd_loop(q, k, v, axis_name, causal, scale, segq, segk)
     # Residuals are O(S_local): the local shards + (o, lse). Without this
     # custom VJP, autodiff saves every ring step's [B,H,Sq,Sk] probability
     # block — backward memory O(S_local x S_global), exactly what ring
     # attention exists to avoid.
-    return out, (q, k, v, out, lse)
+    return out, (q, k, v, segq, segk, out, lse)
 
 
 def _ring_vjp_bwd(axis_name, causal, scale, res, do):
     """Flash-structured ring backward: a second rotation pass. Each step
     recomputes its P block from (q, k_t, lse), accumulates dq locally, and
     accumulates dk/dv into buffers that TRAVEL WITH the K/V shards — after
-    n rotations the shards and their gradients arrive home together."""
-    q, k, v, out, lse = res
+    n rotations the shards and their gradients arrive home together.
+    Segment ids (when present) re-ride the rotation exactly as forward."""
+    q, k, v, segq, segk, out, lse = res
     n = lax.axis_size(axis_name)
     r = lax.axis_index(axis_name)
     hq, hkv = q.shape[2], k.shape[2]
     g_rep = hq // hkv
     b, sq, _, d = q.shape
     sk = k.shape[1]
+    segments = segq is not None
 
     dof = do.astype(jnp.float32)
     # delta = rowsum(dO * O): the softmax-jacobian diagonal term, [B,H,Sq].
@@ -160,7 +185,7 @@ def _ring_vjp_bwd(axis_name, causal, scale, res, do):
     shift_perm = [(i, (i - 1) % n) for i in range(n)]
 
     def step(carry, t):
-        dq, dk, dv, k, v = carry
+        dq, dk, dv, k, v, segk_t = carry
         src = (r + t) % n
         ke = _repeat_kv(k, g_rep)
         ve = _repeat_kv(v, g_rep)
@@ -169,9 +194,17 @@ def _ring_vjp_bwd(axis_name, causal, scale, res, do):
         if causal:
             s = jnp.where(((r * sq + row) >= (src * sk + col))[None, None],
                           s, NEG_INF)
-        # exp(NEG_INF - lse) underflows to exact 0 (lse finite: causal rows
-        # always see their own diagonal position), so no extra zeroing pass.
+        if segments:
+            seg_eq = segq[:, :, None] == segk_t[:, None, :]   # [B,Sq,Sk]
+            s = jnp.where(seg_eq[:, None], s, NEG_INF)
+        # exp(NEG_INF - lse) underflows to exact 0 when lse is finite
+        # (causal rows always see their own diagonal position). A FULLY
+        # masked row (possible only under segment masking with a q-side id
+        # absent from the kv side) has lse ~ NEG_INF, where exp(s - lse)
+        # would EXPLODE instead — force exact zeros for that case.
         p = jnp.exp(s - lse[..., None])
+        if segments:
+            p = jnp.where(s <= NEG_INF / 2, 0.0, p)
         pc = p.astype(do.dtype)
         dv_t = jnp.einsum("bhqk,bqhd->bkhd", pc, do,
                           preferred_element_type=jnp.float32)
@@ -193,16 +226,22 @@ def _ring_vjp_bwd(axis_name, causal, scale, res, do):
         dv = lax.ppermute(dv + dv_t, axis_name, shift_perm)
         k = lax.ppermute(k, axis_name, shift_perm)
         v = lax.ppermute(v, axis_name, shift_perm)
-        return (dq, dk, dv, k, v), None
+        if segments:
+            segk_t = lax.ppermute(segk_t, axis_name, shift_perm)
+        return (dq, dk, dv, k, v, segk_t), None
 
     dq0 = jnp.zeros((b, sq, hq, d), jnp.float32)
     dk0 = jnp.zeros((b, sk, hkv, d), jnp.float32)
     dv0 = jnp.zeros((b, sk, hkv, d), jnp.float32)
-    (dq, dk, dv, _, _), _ = lax.scan(step, (dq0, dk0, dv0, k, v),
-                                     jnp.arange(n))
+    segk0 = segk if segments else jnp.zeros((), jnp.int32)
+    (dq, dk, dv, _, _, _), _ = lax.scan(step, (dq0, dk0, dv0, k, v, segk0),
+                                        jnp.arange(n))
 
+    import numpy as np
+    dseg = None if segq is None else np.zeros(segq.shape, jax.dtypes.float0)
+    dsegk = None if segk is None else np.zeros(segk.shape, jax.dtypes.float0)
     return (dq.astype(q.dtype), dk.astype(res[1].dtype),
-            dv.astype(res[2].dtype))
+            dv.astype(res[2].dtype), dseg, dsegk)
 
 
 _ring.defvjp(_ring_vjp_fwd, _ring_vjp_bwd)
@@ -210,12 +249,20 @@ _ring.defvjp(_ring_vjp_fwd, _ring_vjp_bwd)
 
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                    axis_name: str = "sequence", causal: bool = True,
-                   softmax_scale: float | None = None) -> jax.Array:
+                   softmax_scale: float | None = None,
+                   q_segment_ids: jax.Array | None = None,
+                   kv_segment_ids: jax.Array | None = None) -> jax.Array:
     """Exact attention over a sequence-sharded QKV, inside ``shard_map``.
 
     q/k/v: this device's sequence shard, [B, S_local, H(q|kv), D]. Output has
     q's shape. Matches single-device attention bit-for-bit up to f32 softmax
     reassociation (verified in tests against ``ops.attention``).
+
+    ``q_segment_ids``/``kv_segment_ids`` ([B, S_local] shards of the packed
+    segment ids, given together) restrict attention within equal ids: the
+    K-side ids ride the ring rotation with their shard and every block's
+    mask composes causal ∧ segment-equal — packed long-document training
+    works over the sequence axis.
 
     Differentiation goes through a custom VJP (``_ring_vjp_bwd``) that
     re-rotates K/V and recomputes each P block from the saved (q, k, lse) —
@@ -223,18 +270,35 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     device instead of autodiff's O(S_local x S_global) saved score blocks
     (asserted by a compiled ``memory_analysis`` test).
     """
+    if (q_segment_ids is None) != (kv_segment_ids is None):
+        raise ValueError("q_segment_ids and kv_segment_ids must be given "
+                         "together")
+    if q_segment_ids is not None:
+        q_segment_ids = q_segment_ids.astype(jnp.int32)
+        kv_segment_ids = kv_segment_ids.astype(jnp.int32)
     scale = softmax_scale if softmax_scale is not None else q.shape[-1] ** -0.5
-    return _ring(q, k, v, axis_name, causal, scale)
+    return _ring(q, k, v, q_segment_ids, kv_segment_ids, axis_name, causal,
+                 scale)
 
 
 def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                       axis_name: str = "sequence", causal: bool = True,
                       softmax_scale: float | None = None,
-                      inner: Callable | None = None) -> jax.Array:
+                      inner: Callable | None = None,
+                      q_segment_ids: jax.Array | None = None,
+                      kv_segment_ids: jax.Array | None = None) -> jax.Array:
     """All-to-all sequence parallelism (DeepSpeed-Ulysses scheme), inside
     ``shard_map``: redistribute [B, S/N, H, D] -> [B, S, H/N, D], attend over
     the full sequence locally, redistribute back. Requires H % N == 0.
+
+    Packed segments: after the all-to-all every device attends over the
+    FULL sequence, so the [B, S_local] id shards are all-gathered along the
+    sequence axis (tiny int32 traffic) and passed to the inner attention as
+    its segment mask.
     """
+    if (q_segment_ids is None) != (kv_segment_ids is None):
+        raise ValueError("q_segment_ids and kv_segment_ids must be given "
+                         "together")
     n = lax.axis_size(axis_name)
     hq, hkv = q.shape[2], k.shape[2]
     if hq % n:
@@ -262,6 +326,22 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
         # head blocks and _repeat_kv repeats each kv head consecutively.
         kg = _repeat_kv(kg, hq // hkv)
         vg = _repeat_kv(vg, hq // hkv)
+    if q_segment_ids is not None:
+        segq_full = lax.all_gather(q_segment_ids.astype(jnp.int32),
+                                   axis_name, axis=1, tiled=True)
+        segk_full = lax.all_gather(kv_segment_ids.astype(jnp.int32),
+                                   axis_name, axis=1, tiled=True)
+        if inner is None:
+            from k8s_distributed_deeplearning_tpu.ops.attention import (
+                dot_product_attention, segment_mask)
+            out = dot_product_attention(
+                qg, kg, vg, causal=causal, softmax_scale=softmax_scale,
+                mask=segment_mask(segq_full, segk_full))
+        else:   # flash inner consumes segment ids natively
+            out = inner(qg, kg, vg, causal=causal,
+                        softmax_scale=softmax_scale,
+                        q_segment_ids=segq_full, kv_segment_ids=segk_full)
+        return heads_to_seq(out)
     if inner is None:
         from k8s_distributed_deeplearning_tpu.ops.attention import (
             dot_product_attention)
@@ -296,16 +376,30 @@ def make_context_parallel_attention(
         fn = functools.partial(ulysses_attention, inner=flash_attention)
     batch = tuple(a for a in batch_axes if a in mesh.axis_names)
     spec = P(batch or None, axis_name, None, None)
+    seg_spec = P(batch or None, axis_name)
 
-    def attention_fn(q, k, v, *, causal=True, mask=None, softmax_scale=None):
+    def attention_fn(q, k, v, *, causal=True, mask=None, softmax_scale=None,
+                     segment_ids=None):
         if mask is not None:
             raise NotImplementedError(
-                "context-parallel attention supports causal masking only")
+                "context-parallel attention supports causal and segment "
+                "masking only (general mask arrays don't shard)")
+        if segment_ids is None:
+            sharded = jax.shard_map(
+                functools.partial(fn, axis_name=axis_name, causal=causal,
+                                  softmax_scale=softmax_scale),
+                mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+                check_vma=False)
+            return sharded(q, k, v)
+
+        def seg_fn(q_, k_, v_, seg):
+            return fn(q_, k_, v_, axis_name=axis_name, causal=causal,
+                      softmax_scale=softmax_scale,
+                      q_segment_ids=seg, kv_segment_ids=seg)
+
         sharded = jax.shard_map(
-            functools.partial(fn, axis_name=axis_name, causal=causal,
-                              softmax_scale=softmax_scale),
-            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-            check_vma=False)
-        return sharded(q, k, v)
+            seg_fn, mesh=mesh, in_specs=(spec, spec, spec, seg_spec),
+            out_specs=spec, check_vma=False)
+        return sharded(q, k, v, segment_ids)
 
     return attention_fn

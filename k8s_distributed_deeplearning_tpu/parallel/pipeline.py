@@ -335,8 +335,8 @@ def pipeline_value_and_grad_interleaved(
 
     Versus the plain uniform 1F1B: ticks are CHUNK-sized (1/V of a stage),
     so the drain shrinks — total ticks M·V + P·V + P - 1 of work 1/V each,
-    i.e. bubble fraction (PV + P - 2)/(MV + PV + P - 2) vs (2P-1)/(M+2P-1)
-    (at P=4, M=16, V=2: 0.238 vs 0.304), at the same O(P) activation
+    i.e. bubble fraction (PV + P - 1)/(MV + PV + P - 1) vs (2P-1)/(M+2P-1)
+    (at P=4, M=16, V=2: 11/43 = 0.256 vs 0.304), at the same O(P) activation
     memory (ring of min(MV, 2PV) chunk-inputs = the 1F1B bound). GPipe's
     (P-1)/(M+P-1) latency bubble remains lower at O(M) memory; a fully
     Megatron-style non-uniform warmup (double-rate forward ticks) would

@@ -198,7 +198,7 @@ class PipelineTrainer:
       (:func:`parallel.pipeline.pipeline_value_and_grad_interleaved`):
       each device holds ``num_virtual`` non-contiguous layer chunks, the
       head/loss computes only on head slots, bubble
-      (PV+P-2)/(MV+PV+P-2) at the same O(P) memory. Needs
+      (PV+P-1)/(MV+PV+P-1) at the same O(P) memory. Needs
       ``num_microbatches % stages == 0`` and
       ``n_layers % (stages * num_virtual) == 0``. The TrainState stores
       block weights chunk-arranged as ``[V, P, L/(P·V), ...]`` (a free
@@ -405,77 +405,18 @@ class PipelineTrainer:
             grads["head"] = {"lm_head": {"kernel": g_head["unembed"]}}
         return grads
 
-    # -- 1f1b engine -------------------------------------------------------
-    def _value_and_grad_1f1b(self, params, batch, rng=None):
-        """Loss + full param gradients through the interleaved 1F1B
+    # -- schedule engines (1f1b + interleaved share one body) --------------
+    def _value_and_grad_schedule(self, params, batch, rng=None):
+        """Loss + full param gradients through the configured 1F1B-family
         schedule. The schedule owns embedding forward/backward and the
-        head-side loss; gradients are reassembled into the params tree."""
+        head-side loss; gradients are reassembled into the params tree.
+        ONE body for both engines — the only differences are the blocks
+        sharding spec ([L,...] over the pipeline axis vs chunk-arranged
+        [V, P, nl, ...] over dim 1) and the pipeline function called."""
         import flax.linen as nn
         from k8s_distributed_deeplearning_tpu.models.llama import unembedding
 
-        cfg = self.model.cfg
-        _check_supported(cfg, batch)
-        if not cfg.dropout_rate:
-            rng = None
-        params = nn.meta.unbox(params)
-        inputs, targets, seg_in, mask = _prepare_lm_batch(batch)
-        total_mask = jnp.maximum(mask.sum(), 1.0)   # known pre-schedule
-
-        tp = params["transformer"]
-        w, layout = unembedding(cfg, params)
-        head_side = {"final_norm": tp["final_norm"], "unembed": w}
-        loss_mb_fn = self._make_loss_mb_fn(layout)
-        block_fn = block_fn_from_config(cfg)
-        packed = seg_in is not None
-        stochastic = rng is not None
-        axis, m = self.axis_name, self.num_microbatches
-        pspec = P(axis)
-        xspec = P(self.data_axes or None)
-        in_specs = [pspec, P(), xspec, xspec, P()]
-        if packed:
-            in_specs.append(xspec)
-        if stochastic:
-            in_specs.append(P())
-
-        def inner(blocks, head, x, aux, tm, *rest):
-            rest = list(rest)
-            extras = rest.pop(0) if packed else None
-            r = rest.pop(0) if stochastic else None
-            return pipeline.pipeline_value_and_grad_1f1b(
-                block_fn,
-                lambda hp, y, a: loss_mb_fn(hp, y, a, tm),
-                blocks, head, x, aux,
-                num_microbatches=m, axis_name=axis, extras=extras, rng=r,
-                reduce_axes=self.data_axes)
-
-        sharded = jax.shard_map(
-            inner, mesh=self.mesh,
-            in_specs=tuple(in_specs),
-            out_specs=(P(), P(), pspec, P(), xspec),
-            check_vma=False)
-
-        emb = tp["tok_embed"]["embedding"]
-        x = jnp.take(emb, inputs, axis=0).astype(cfg.dtype)
-        aux_tree = {"targets": targets, "mask": mask}
-        args = [tp["blocks"], head_side, x, aux_tree, total_mask]
-        if packed:
-            args.append({"segment_ids": seg_in,
-                         "positions": tfm.packed_positions(seg_in)})
-        if stochastic:
-            args.append(rng)
-        loss, metrics, g_blocks, g_head, dx = sharded(*args)
-
-        grads = self._assemble_grads(inputs, dx, g_blocks, g_head, emb)
-        return loss, {"accuracy": metrics["accuracy"],
-                      "perplexity": jnp.exp(loss)}, grads
-
-    def _value_and_grad_interleaved(self, params, batch, rng=None):
-        """Loss + gradients through the interleaved-virtual-stage schedule
-        (same ownership contract as :meth:`_value_and_grad_1f1b`; block
-        weights and their grads are chunk-arranged [V, P, L/(PV), ...])."""
-        import flax.linen as nn
-        from k8s_distributed_deeplearning_tpu.models.llama import unembedding
-
+        interleaved = self.schedule == "interleaved"
         cfg = self.model.cfg
         _check_supported(cfg, batch)
         if not cfg.dropout_rate:
@@ -492,7 +433,9 @@ class PipelineTrainer:
         packed = seg_in is not None
         stochastic = rng is not None
         axis, m, v = self.axis_name, self.num_microbatches, self.num_virtual
-        blocks_spec = P(None, axis)       # [V, P, nl, ...]: shard dim 1
+        # Blocks: [L, ...] stage-sharded, or chunk-arranged [V, P, nl, ...]
+        # with the device dim sharded (see _chunk_blocks).
+        blocks_spec = P(None, axis) if interleaved else P(axis)
         xspec = P(self.data_axes or None)
         in_specs = [blocks_spec, P(), xspec, xspec, P()]
         if packed:
@@ -504,17 +447,21 @@ class PipelineTrainer:
             rest = list(rest)
             extras = rest.pop(0) if packed else None
             r = rest.pop(0) if stochastic else None
-            # Local view [V, 1, nl, ...] -> [V, nl, ...].
-            local = jax.tree.map(lambda a: a.squeeze(1), blocks)
-            out = pipeline.pipeline_value_and_grad_interleaved(
-                block_fn,
-                lambda hp, y, a: loss_mb_fn(hp, y, a, tm),
-                local, head, x, aux,
-                num_microbatches=m, num_virtual=v, axis_name=axis,
-                extras=extras, rng=r, reduce_axes=self.data_axes)
-            loss, auxs, g_chunks, g_head, dx = out
-            g_chunks = jax.tree.map(lambda a: a[:, None], g_chunks)
-            return loss, auxs, g_chunks, g_head, dx
+            mb_loss = lambda hp, y, a: loss_mb_fn(hp, y, a, tm)
+            if interleaved:
+                # Local view [V, 1, nl, ...] -> [V, nl, ...].
+                local = jax.tree.map(lambda a: a.squeeze(1), blocks)
+                loss, auxs, g_chunks, g_head, dx = (
+                    pipeline.pipeline_value_and_grad_interleaved(
+                        block_fn, mb_loss, local, head, x, aux,
+                        num_microbatches=m, num_virtual=v, axis_name=axis,
+                        extras=extras, rng=r, reduce_axes=self.data_axes))
+                g_chunks = jax.tree.map(lambda a: a[:, None], g_chunks)
+                return loss, auxs, g_chunks, g_head, dx
+            return pipeline.pipeline_value_and_grad_1f1b(
+                block_fn, mb_loss, blocks, head, x, aux,
+                num_microbatches=m, axis_name=axis, extras=extras, rng=r,
+                reduce_axes=self.data_axes)
 
         sharded = jax.shard_map(
             inner, mesh=self.mesh,
@@ -541,11 +488,8 @@ class PipelineTrainer:
         opt = self.optimizer
 
         def step(state: TrainState, batch: PyTree, rng: jax.Array):
-            if self.schedule == "interleaved":
-                loss, aux, grads = self._value_and_grad_interleaved(
-                    state.params, batch, rng)
-            elif self.schedule == "1f1b":
-                loss, aux, grads = self._value_and_grad_1f1b(
+            if self.schedule in ("1f1b", "interleaved"):
+                loss, aux, grads = self._value_and_grad_schedule(
                     state.params, batch, rng)
             else:
                 (loss, aux), grads = jax.value_and_grad(
@@ -560,10 +504,8 @@ class PipelineTrainer:
     def value_and_grad(self, params, batch, rng=None):
         """(loss, aux, grads) through the configured schedule — the 1f1b
         parity-test surface (gpipe goes through autodiff)."""
-        if self.schedule == "interleaved":
-            return self._value_and_grad_interleaved(params, batch, rng)
-        if self.schedule == "1f1b":
-            return self._value_and_grad_1f1b(params, batch, rng)
+        if self.schedule in ("1f1b", "interleaved"):
+            return self._value_and_grad_schedule(params, batch, rng)
         (loss, aux), grads = jax.value_and_grad(
             self.loss_fn, has_aux=True)(params, batch, rng)
         return loss, aux, grads

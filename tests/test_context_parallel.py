@@ -202,3 +202,149 @@ def test_llama_long_context_trains_with_ring_attention():
         state, l, _ = step(state, batch, jax.random.key(i))
         losses.append(float(l))
     assert all(np.isfinite(losses)) and losses[-1] < losses[0], losses
+
+
+def _seg_ids(b=2, s=32, n_docs=3, seed=5):
+    """Contiguous packed-style segment ids, [B, S] int32 (no padding)."""
+    ids = np.sort(np.random.default_rng(seed).integers(
+        1, n_docs + 1, size=(b, s)), axis=1).astype(np.int32)
+    return jnp.asarray(ids)
+
+
+def _run_sharded_seg(fn, q, k, v, seg, n=8, **kw):
+    mesh = mesh_lib.make_mesh({"sequence": n})
+    spec = P(None, "sequence", None, None)
+    sspec = P(None, "sequence")
+
+    def inner(q_, k_, v_, s_):
+        return fn(q_, k_, v_, q_segment_ids=s_, kv_segment_ids=s_, **kw)
+
+    wrapped = jax.shard_map(inner, mesh=mesh,
+                            in_specs=(spec, spec, spec, sspec),
+                            out_specs=spec, check_vma=False)
+    return jax.jit(wrapped)(q, k, v, seg)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_attention_segments_match_reference(causal):
+    """Packed × CP (VERDICT r3 #7): segment ids ride the rotation with K/V;
+    ring output must equal the single-device segment-masked reference."""
+    q, k, v = _qkv()
+    seg = _seg_ids()
+    ref = attn_ops.dot_product_attention(
+        q, k, v, causal=causal, mask=attn_ops.segment_mask(seg, seg))
+    out = _run_sharded_seg(cp.ring_attention, q, k, v, seg, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_ring_attention_segments_grads_match():
+    q, k, v = _qkv(s=16)
+    seg = _seg_ids(s=16)
+    mask = attn_ops.segment_mask(seg, seg)
+
+    def loss_ref(q, k, v):
+        return (attn_ops.dot_product_attention(
+            q, k, v, causal=True, mask=mask) ** 2).sum()
+
+    def loss_ring(q, k, v):
+        return (_run_sharded_seg(cp.ring_attention, q, k, v, seg,
+                                 causal=True) ** 2).sum()
+
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    g_r = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+    for name, a, b in zip("qkv", g_ref, g_r):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a), atol=2e-4,
+                                   err_msg=f"d{name}")
+
+
+def test_ring_segments_isolate_documents_across_shards():
+    """A document's outputs must not change when ANOTHER document (living
+    on other sequence shards) changes — the cross-shard packing-isolation
+    property."""
+    q, k, v = _qkv()
+    seg = jnp.concatenate([jnp.full((2, 16), 1, jnp.int32),
+                           jnp.full((2, 16), 2, jnp.int32)], axis=1)
+    base = _run_sharded_seg(cp.ring_attention, q, k, v, seg, causal=True)
+    k2 = k.at[:, 16:].set(jax.random.normal(jax.random.key(9),
+                                            k[:, 16:].shape))
+    out2 = _run_sharded_seg(cp.ring_attention, q, k2, v, seg, causal=True)
+    np.testing.assert_array_equal(np.asarray(base[:, :16]),
+                                  np.asarray(out2[:, :16]))
+
+
+def test_ring_segments_fully_masked_row_has_zero_grads():
+    """A q row whose segment id appears NOWHERE on the kv side (e.g. a
+    q-only pad sentinel) is fully masked: its output and its contribution
+    to every gradient must be exactly zero — not the exp(s - lse)
+    explosion a degenerate lse would produce."""
+    q, k, v = _qkv(s=16)
+    segq = jnp.concatenate([jnp.full((2, 8), 1, jnp.int32),
+                            jnp.full((2, 8), 9, jnp.int32)], axis=1)
+    segk = jnp.full((2, 16), 1, jnp.int32)   # id 9 never matches
+
+    def run(q, k, v):
+        mesh = mesh_lib.make_mesh({"sequence": 8})
+        spec = P(None, "sequence", None, None)
+        sspec = P(None, "sequence")
+        wrapped = jax.shard_map(
+            lambda q_, k_, v_, sq_, sk_: cp.ring_attention(
+                q_, k_, v_, causal=False, q_segment_ids=sq_,
+                kv_segment_ids=sk_),
+            mesh=mesh, in_specs=(spec, spec, spec, sspec, sspec),
+            out_specs=spec, check_vma=False)
+        return wrapped(q, k, v, segq, segk)
+
+    out = run(q, k, v)
+    np.testing.assert_array_equal(np.asarray(out[:, 8:]), 0.0)
+    g = jax.grad(lambda q, k, v: (run(q, k, v) ** 2).sum(),
+                 argnums=(0, 1, 2))(q, k, v)
+    for name, a in zip("qkv", g):
+        arr = np.asarray(a)
+        assert np.isfinite(arr).all(), f"d{name} not finite"
+    np.testing.assert_array_equal(np.asarray(g[0][:, 8:]), 0.0)
+
+
+def test_ulysses_segments_match_reference():
+    q, k, v = _qkv(hq=8)
+    seg = _seg_ids()
+    ref = attn_ops.dot_product_attention(
+        q, k, v, causal=True, mask=attn_ops.segment_mask(seg, seg))
+    out = _run_sharded_seg(cp.ulysses_attention, q, k, v, seg, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_packed_llama_matches_single_device_over_sequence_axis():
+    """THE round-4 closure of transformer.py's packed × CP guard: the full
+    packed-LM loss (segment-masked attention, per-document RoPE, masked
+    CE) through ring attention over the sequence axis must match the
+    single-device packed path."""
+    cfg = llama.config_tiny(vocab_size=64, dim=32, n_layers=2, n_heads=4,
+                            n_kv_heads=2, mlp_dim=64, max_seq_len=64,
+                            dtype=jnp.float32)
+    model = llama.LlamaLM(cfg)
+    b, s = 2, 33       # loss_fn shifts: inputs are s-1 = 32 = 8 shards x 4
+    toks = jnp.asarray(np.random.default_rng(0).integers(
+        0, 64, size=(b, s), dtype=np.int32))
+    seg = jnp.asarray(np.sort(np.random.default_rng(1).integers(
+        1, 4, size=(b, s)), axis=1).astype(np.int32))
+    batch = {"tokens": toks, "segment_ids": seg}
+    params = model.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))[
+        "params"]
+
+    l_ref, aux_ref = llama.loss_fn(model, params, batch)
+
+    mesh = mesh_lib.make_mesh({"sequence": 8})
+    attn = cp.make_context_parallel_attention(mesh, impl="ring")
+    l_cp, aux_cp = llama.loss_fn(model, params, batch, attention_fn=attn)
+    np.testing.assert_allclose(float(l_cp), float(l_ref), rtol=1e-5)
+    np.testing.assert_allclose(float(aux_cp["accuracy"]),
+                               float(aux_ref["accuracy"]), rtol=1e-5)
+
+    # Gradients through the packed CP path match too.
+    g_ref = jax.grad(lambda p: llama.loss_fn(model, p, batch)[0])(params)
+    g_cp = jax.grad(lambda p: llama.loss_fn(model, p, batch,
+                                            attention_fn=attn)[0])(params)
+    jax.tree.map(
+        lambda a, b_: np.testing.assert_allclose(a, b_, rtol=2e-4,
+                                                 atol=2e-6),
+        g_ref, g_cp)
