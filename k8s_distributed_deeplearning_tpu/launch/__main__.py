@@ -38,7 +38,9 @@ def main(argv: list[str] | None = None) -> int:
     parsers = {}
     for name, help_ in (("render", "render TPUJob manifests to stdout"),
                         ("validate", "validate rendered manifests"),
-                        ("run-local", "execute the rendered job locally")):
+                        ("run-local", "execute the rendered job locally"),
+                        ("watch", "apply + reconcile the gang on-cluster "
+                                  "(the MPI Operator's live loop)")):
         p = parsers[name] = sub.add_parser(name, help=help_)
         p.add_argument("--name", default=d.name)
         p.add_argument("--namespace", default=d.namespace)
@@ -52,6 +54,20 @@ def main(argv: list[str] | None = None) -> int:
     parsers["render"].add_argument(
         "--apply", action="store_true",
         help="pipe the manifests into kubectl apply -f -")
+    parsers["watch"].add_argument(
+        "--max-restarts", type=int, default=3,
+        help="reconcile attempts before giving up")
+    parsers["watch"].add_argument(
+        "--attempt-timeout", type=float, default=1800.0,
+        help="seconds without completion before the gang counts as broken")
+    parsers["watch"].add_argument(
+        "--poll-interval", type=float, default=5.0)
+    parsers["watch"].add_argument(
+        "--resize-to", type=int, default=None,
+        help="world size to restart failed gangs at (default: same size)")
+    parsers["watch"].add_argument(
+        "--no-apply", dest="apply_first", action="store_false",
+        help="reconcile an already-applied job instead of applying first")
     parsers["run-local"].add_argument("--timeout", type=int, default=600)
     parsers["run-local"].add_argument(
         "--max-restarts", type=int, default=0,
@@ -81,6 +97,25 @@ def main(argv: list[str] | None = None) -> int:
                     print(out, file=sys.stderr)
                     return 1
         return 1 if errors else 0
+
+    if args.cmd == "watch":
+        from k8s_distributed_deeplearning_tpu.launch import watch as watch_mod
+        try:
+            result = watch_mod.watch(
+                cfg,
+                resize=(watch_mod.resize_to(args.resize_to)
+                        if args.resize_to else None),
+                max_restarts=args.max_restarts,
+                attempt_timeout=args.attempt_timeout,
+                poll_interval=args.poll_interval,
+                apply_first=args.apply_first,
+                on_event=lambda m: print(f"watch: {m}", file=sys.stderr))
+        except (RuntimeError, ValueError) as e:
+            print(f"watch failed: {e}", file=sys.stderr)
+            return 1
+        print(f"job {result.cfg.name} complete at world size "
+              f"{result.cfg.num_workers} ({result.restarts} restart(s))")
+        return 0
 
     if args.cmd == "run-local":
         from k8s_distributed_deeplearning_tpu.launch import local_executor

@@ -31,12 +31,17 @@ from k8s_distributed_deeplearning_tpu.launch.local_executor import (
     run_local,
 )
 
-ResizeFn = Callable[[JobConfig, list[WorkerResult]], JobConfig]
+# A resize policy maps (current config, observed failure state) -> next
+# config. The observation type depends on the loop: run_elastic passes the
+# local gang's list[WorkerResult]; launch.watch passes its GangStatus.
+# ONE policy type serves both (the built-in ignores the observation).
+ResizeFn = Callable[[JobConfig, object], JobConfig]
 
 
 def resize_to(num_workers: int) -> ResizeFn:
-    """Resize policy: restart at a fixed new world size."""
-    def fn(cfg: JobConfig, _failed: list[WorkerResult]) -> JobConfig:
+    """Resize policy: restart at a fixed new world size (works with both
+    the local run_elastic loop and the on-cluster launch.watch loop)."""
+    def fn(cfg: JobConfig, _observed: object) -> JobConfig:
         return dataclasses.replace(cfg, num_workers=num_workers)
     return fn
 

@@ -1,0 +1,207 @@
+"""``launch watch`` — the on-cluster reconcile loop.
+
+The reference's MPI Operator (installed at ``deploy_stack.sh:38``) is a
+LIVE controller: it watches MPIJob objects and their pods and re-creates
+the gang when it breaks. Rounds 1-3 carried the TPU-native equivalent only
+against the local executor (``launch/elastic.py`` → ``run_local``); this
+module promotes the same reconcile semantics to the K8s API:
+
+- the desired state is exactly the rendered objects (``launch/render.py``
+  — world size lives in ONE Indexed Job's completions/parallelism + env);
+- :func:`watch` observes the gang through the Job status (``kubectl get
+  job -o json``): completion ends the loop; a terminal ``Failed``
+  condition (worker exits beyond backoffLimit) or an attempt TIMEOUT (the
+  canonical broken-gang mode — a killed/evicted pod leaves peers parked
+  at a collective, so the job neither fails nor finishes) triggers
+  reconcile;
+- reconcile = delete the Job (foreground), pick the next world size via
+  the resize policy, re-render, re-apply. Workers resume from their
+  checkpoint directory — state survives through the checkpoint stream,
+  not live process membership (``launch/elastic.py`` module docstring;
+  cross-topology restore proven in ``tests/test_checkpoint.py``).
+
+kubectl access is behind the injectable :class:`Kubectl` so the reconcile
+logic is unit-tested with a scripted fake
+(``tests/test_watch.py``) and exercised for real in the kind-gated e2e
+(``tests/test_cluster_e2e.py::test_watch_reconciles_killed_worker``),
+where killing a worker pod mid-run ends with the job complete at a new
+world size — the MPI Operator's live-reconcile capability.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import subprocess
+import time
+from typing import Callable
+
+from k8s_distributed_deeplearning_tpu.config import JobConfig
+from k8s_distributed_deeplearning_tpu.launch import render, validate
+from k8s_distributed_deeplearning_tpu.launch.elastic import (  # noqa: F401
+    ResizeFn,
+    resize_to,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class GangStatus:
+    """Observed state of the gang's Job object."""
+    exists: bool = False
+    active: int = 0
+    succeeded: int = 0
+    failed: int = 0
+    job_failed: bool = False    # terminal Failed condition (backoff exceeded)
+
+    def complete(self, cfg: JobConfig) -> bool:
+        return self.succeeded >= cfg.num_workers
+
+
+class Kubectl:
+    """Thin shell client for the few verbs the watcher needs. *runner* is
+    injectable (tests script it); the default shells to ``kubectl``."""
+
+    def __init__(self, context: str | None = None,
+                 runner: Callable | None = None):
+        self.context = context
+        self._runner = runner or self._subprocess_runner
+
+    def _subprocess_runner(self, args: list[str], input_text: str | None,
+                           timeout: float = 120.0) -> tuple[int, str, str]:
+        base = ["kubectl"] + (["--context", self.context]
+                              if self.context else [])
+        try:
+            proc = subprocess.run(base + args, input=input_text,
+                                  capture_output=True, text=True,
+                                  timeout=timeout)
+        except subprocess.TimeoutExpired as e:
+            # Surface as the loop's error type — a reconcile must never
+            # die on a raw TimeoutExpired traceback mid-recovery.
+            raise RuntimeError(f"kubectl {' '.join(args[:2])} timed out "
+                              f"after {timeout}s") from e
+        except FileNotFoundError as e:
+            raise RuntimeError(
+                "kubectl not found on PATH — launch watch needs cluster "
+                "access (use run-local --max-restarts for the no-cluster "
+                "reconcile loop)") from e
+        return proc.returncode, proc.stdout, proc.stderr
+
+    def _run_kubectl(self, args, input_text=None, timeout=120.0):
+        try:
+            return self._runner(args, input_text, timeout)
+        except TypeError:   # injected test runners take (args, input) only
+            return self._runner(args, input_text)
+
+    def apply(self, text: str) -> None:
+        rc, _, err = self._run_kubectl(["apply", "-f", "-"], text)
+        if rc:
+            raise RuntimeError(f"kubectl apply failed: {err[-2000:]}")
+
+    def delete_job(self, cfg: JobConfig) -> None:
+        """Foreground-delete the gang's Job (pods gone before return);
+        absent Job is fine (first reconcile after an external delete).
+        Long timeout: foreground cascade waits out pod termination grace
+        periods."""
+        rc, _, err = self._run_kubectl(
+            ["delete", "job", cfg.name, "-n", cfg.namespace,
+             "--cascade=foreground", "--wait=true", "--ignore-not-found"],
+            None, timeout=600.0)
+        if rc:
+            raise RuntimeError(f"kubectl delete job failed: {err[-2000:]}")
+
+    def job_status(self, cfg: JobConfig) -> GangStatus:
+        rc, out, err = self._run_kubectl(
+            ["get", "job", cfg.name, "-n", cfg.namespace, "-o", "json"])
+        if rc:
+            if "NotFound" in err or "not found" in err:
+                return GangStatus(exists=False)
+            raise RuntimeError(f"kubectl get job failed: {err[-2000:]}")
+        status = json.loads(out).get("status", {})
+        failed_cond = any(
+            c.get("type") == "Failed" and c.get("status") == "True"
+            for c in status.get("conditions") or [])
+        return GangStatus(exists=True,
+                          active=int(status.get("active") or 0),
+                          succeeded=int(status.get("succeeded") or 0),
+                          failed=int(status.get("failed") or 0),
+                          job_failed=failed_cond)
+
+
+@dataclasses.dataclass
+class WatchResult:
+    cfg: JobConfig          # final (possibly resized) job config
+    restarts: int
+    status: GangStatus
+
+
+def watch(cfg: JobConfig, *,
+          kubectl: Kubectl | None = None,
+          resize: ResizeFn | None = None,
+          max_restarts: int = 3,
+          attempt_timeout: float = 1800.0,
+          poll_interval: float = 5.0,
+          apply_first: bool = True,
+          on_event: Callable[[str], None] | None = None,
+          clock: Callable[[], float] = time.monotonic,
+          sleep: Callable[[float], None] = time.sleep) -> WatchResult:
+    """Reconcile the gang against the cluster until it completes.
+
+    Each ATTEMPT applies the rendered objects (validated first — the
+    reference's apply-and-hope at ``deploy_stack.sh:46`` inverted) and
+    polls the Job. Completion returns. A terminal Failed condition OR
+    *attempt_timeout* without completion consumes a restart: the Job is
+    foreground-deleted, *resize* picks the next world size (default: same
+    size — crash recovery), and the re-rendered gang resumes from its
+    checkpoint directory. More than *max_restarts* failed attempts raises
+    with the last observed status.
+
+    *clock*/*sleep* are injectable for deterministic unit tests.
+    """
+    kubectl = kubectl or Kubectl()
+    emit = on_event or (lambda _msg: None)
+    restarts = 0
+
+    def apply_current(c: JobConfig) -> None:
+        docs = render.render_all(c)
+        validate.validate_or_raise(docs)
+        kubectl.apply(render.to_yaml(docs))
+        emit(f"applied {c.name} at world size {c.num_workers}")
+
+    if apply_first:
+        apply_current(cfg)
+
+    while True:
+        deadline = clock() + attempt_timeout
+        status = GangStatus()
+        failed = False
+        while clock() < deadline:
+            status = kubectl.job_status(cfg)
+            if status.complete(cfg):
+                emit(f"complete: {status.succeeded}/{cfg.num_workers} "
+                     "succeeded")
+                return WatchResult(cfg, restarts, status)
+            if status.job_failed:
+                emit(f"job Failed condition (failed pods: {status.failed})")
+                failed = True
+                break
+            sleep(poll_interval)
+        if not failed:
+            emit(f"attempt timed out after {attempt_timeout}s "
+                 f"(active={status.active}, succeeded={status.succeeded})"
+                 " — treating the gang as broken")
+        restarts += 1
+        if restarts > max_restarts:
+            raise RuntimeError(
+                f"gang failed {restarts} attempts (last status: "
+                f"active={status.active} succeeded={status.succeeded} "
+                f"failed={status.failed} job_failed={status.job_failed})")
+        # Delete under the OLD identity first — a resize policy may change
+        # name/namespace, and the broken gang must not leak on-cluster.
+        kubectl.delete_job(cfg)
+        if resize is not None:
+            new_cfg = resize(cfg, status)
+            if new_cfg.num_workers != cfg.num_workers:
+                emit(f"resizing {cfg.num_workers} -> {new_cfg.num_workers} "
+                     "workers")
+            cfg = new_cfg
+        emit(f"restart {restarts}/{max_restarts}: re-applying")
+        apply_current(cfg)

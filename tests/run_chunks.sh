@@ -30,7 +30,7 @@ CHUNKS[parallel1]="tests/test_collectives.py tests/test_data_parallel.py tests/t
 CHUNKS[parallel2]="tests/test_context_parallel.py tests/test_pipeline.py tests/test_pipeline_lm.py tests/test_moe.py"
 CHUNKS[train]="tests/test_mnist_convergence.py tests/test_grad_accum.py tests/test_chunked_ce.py tests/test_checkpoint.py tests/test_data.py tests/test_prefetch.py tests/test_metrics.py tests/test_profiling.py tests/test_fusion.py"
 CHUNKS[llama]="tests/test_train_llama.py tests/test_generate.py"
-CHUNKS[deploy]="tests/test_render.py tests/test_deploy_smoke.py tests/test_elastic.py tests/test_preemption.py tests/test_cluster_e2e.py"
+CHUNKS[deploy]="tests/test_watch.py tests/test_render.py tests/test_deploy_smoke.py tests/test_elastic.py tests/test_preemption.py tests/test_cluster_e2e.py"
 CHUNKS[slow1]="tests/test_train_e2e.py tests/test_multiprocess.py"
 CHUNKS[slow2]="tests/test_multihost_train.py tests/test_multihost_llama.py tests/test_train_zoo.py"
 ORDER=(core parallel1 parallel2 train llama deploy slow1 slow2)

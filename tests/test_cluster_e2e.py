@@ -123,6 +123,110 @@ def test_rendered_job_runs_on_cluster():
 
 
 @pytest.mark.slow
+def test_watch_reconciles_killed_worker():
+    """The MPI Operator live-reconcile capability (VERDICT r3 #6): a worker
+    pod is KILLED mid-run, leaving a gang that can never complete (peers
+    parked — emulated by workers that only succeed at world size 1); the
+    ``launch watch`` loop must detect the broken gang, re-render at the
+    resize policy's new world size, re-apply, and end with the job
+    COMPLETE at that new size."""
+    import threading
+
+    from k8s_distributed_deeplearning_tpu.launch import render as render_mod
+    from k8s_distributed_deeplearning_tpu.launch import watch as watch_mod
+
+    ctx = _cluster_context()
+    if ctx is None:
+        pytest.skip("no cluster/docker: kubectl has no reachable cluster "
+                    "and kind+docker are not available to create one")
+    mode, kind_name = ctx
+    if mode == "kind":
+        created = _run(["kind", "create", "cluster", "--name", kind_name,
+                        "--wait", "120s"], timeout=300)
+        assert created.returncode == 0, created.stderr
+
+    run_id = uuid.uuid4().hex[:6]
+    cfg = JobConfig(name=f"watch-{run_id}", namespace=f"kddl-e2e-{run_id}",
+                    num_workers=2, cpu="100m", memory="128Mi")
+
+    # Workers emulate gang semantics without TPUs: at world size > 1 they
+    # park forever (a broken collective); at world size 1 they finish.
+    command = ["python", "-c",
+               "import os, time; n = os.environ['TPUJOB_NUM_PROCESSES']; "
+               "print('world', n, flush=True); "
+               "time.sleep(3600) if n != '1' else time.sleep(2)"]
+
+    real_render = render_mod.render_all
+
+    def patched_render(c):
+        objs = real_render(c)
+        for obj in objs:
+            if obj["kind"] != "Job":
+                continue
+            spec = obj["spec"]["template"]["spec"]
+            spec.pop("nodeSelector", None)
+            cont = spec["containers"][0]
+            cont["image"] = "python:3.11-slim"
+            cont["resources"]["limits"].pop("google.com/tpu", None)
+            cont["command"] = command
+        return objs
+
+    watch_mod.render.render_all = patched_render
+    events = []
+    result_box = {}
+
+    def run_watch():
+        try:
+            result_box["result"] = watch_mod.watch(
+                cfg, resize=watch_mod.resize_to(1), max_restarts=2,
+                # Generous first-attempt budget: a fresh kind node may
+                # spend minutes pulling python:3.11-slim before the gang
+                # can even start.
+                attempt_timeout=240.0, poll_interval=3.0,
+                on_event=events.append)
+        except Exception as e:            # surfaced by the main thread
+            result_box["error"] = e
+
+    try:
+        t = threading.Thread(target=run_watch)
+        t.start()
+        # Wait for the 2-worker gang to come up, then KILL worker pod 1.
+        deadline = 230
+        killed = False
+        for _ in range(deadline // 5):
+            pods = _run(["kubectl", "-n", cfg.namespace, "get", "pods",
+                         "-l", f"job-name={cfg.name}", "-o", "json"])
+            if pods.returncode == 0:
+                items = json.loads(pods.stdout).get("items", [])
+                running = [p for p in items
+                           if p["status"].get("phase") == "Running"]
+                if len(running) >= 2:
+                    victim = running[-1]["metadata"]["name"]
+                    _run(["kubectl", "-n", cfg.namespace, "delete", "pod",
+                          victim, "--wait=false"])
+                    killed = True
+                    break
+            import time
+            time.sleep(5)
+        assert killed, "2-worker gang never came up to kill a pod in"
+        t.join(timeout=900)
+        assert not t.is_alive(), f"watch did not converge; events={events}"
+        assert "error" not in result_box, result_box.get("error")
+        result = result_box["result"]
+        # The reconcile ran and the job completed at the NEW world size.
+        assert result.restarts >= 1, events
+        assert result.cfg.num_workers == 1, events
+        assert result.status.succeeded >= 1, events
+    finally:
+        watch_mod.render.render_all = real_render
+        _run(["kubectl", "delete", "namespace", cfg.namespace,
+              "--ignore-not-found", "--wait=false"], timeout=120)
+        if mode == "kind":
+            _run(["kind", "delete", "cluster", "--name", kind_name],
+                 timeout=180)
+
+
+@pytest.mark.slow
 def test_training_image_builds():
     if not shutil.which("docker") or _run(
             ["docker", "info"], timeout=30).returncode != 0:

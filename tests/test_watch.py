@@ -1,0 +1,123 @@
+"""The on-cluster reconcile loop (launch watch), unit-tested against a
+scripted kubectl fake — the logic the kind-gated e2e exercises for real
+(``test_cluster_e2e.py::test_watch_reconciles_killed_worker``)."""
+import json
+
+import pytest
+
+from k8s_distributed_deeplearning_tpu.config import JobConfig
+from k8s_distributed_deeplearning_tpu.launch import watch as watch_mod
+
+
+class FakeCluster:
+    """Scripted kubectl runner: serves job_status from a queue of statuses
+    and records every apply/delete."""
+
+    def __init__(self, statuses):
+        self.statuses = list(statuses)       # popped per `get job` call
+        self.calls = []                      # (verb, detail)
+
+    def runner(self, args, input_text):
+        verb = args[0]
+        if verb == "apply":
+            self.calls.append(("apply", input_text))
+            return 0, "applied", ""
+        if verb == "delete":
+            self.calls.append(("delete", args[2]))
+            return 0, "deleted", ""
+        if verb == "get":
+            st = self.statuses.pop(0) if self.statuses else self.statuses_tail
+            self.calls.append(("get", st))
+            if st is None:
+                return 1, "", 'jobs.batch "x" not found (NotFound)'
+            return 0, json.dumps({"status": st}), ""
+        raise AssertionError(f"unexpected kubectl verb {args!r}")
+
+    @property
+    def statuses_tail(self):
+        return {"succeeded": 0, "active": 0}
+
+
+def _watch(cluster, cfg, **kw):
+    fake_time = {"t": 0.0}
+
+    def clock():
+        return fake_time["t"]
+
+    def sleep(dt):
+        fake_time["t"] += dt
+
+    return watch_mod.watch(
+        cfg, kubectl=watch_mod.Kubectl(runner=cluster.runner),
+        clock=clock, sleep=sleep, poll_interval=1.0, **kw)
+
+
+def test_watch_completes():
+    cfg = JobConfig(num_workers=2)
+    cluster = FakeCluster([
+        {"active": 2, "succeeded": 0},
+        {"active": 1, "succeeded": 1},
+        {"active": 0, "succeeded": 2},
+    ])
+    result = _watch(cluster, cfg, attempt_timeout=100.0)
+    assert result.restarts == 0
+    assert result.status.succeeded == 2
+    assert [c[0] for c in cluster.calls][0] == "apply"
+
+
+def test_watch_reconciles_failed_job_with_resize():
+    """Terminal Failed condition -> delete + resize + re-apply; the
+    resized gang completes."""
+    cfg = JobConfig(num_workers=2)
+    cluster = FakeCluster([
+        {"active": 2, "succeeded": 0},
+        {"active": 0, "succeeded": 0, "failed": 4,
+         "conditions": [{"type": "Failed", "status": "True"}]},
+        {"active": 1, "succeeded": 0},
+        {"active": 0, "succeeded": 1},      # complete at NEW size 1
+    ])
+    result = _watch(cluster, cfg, attempt_timeout=100.0,
+                    resize=watch_mod.resize_to(1))
+    assert result.restarts == 1
+    assert result.cfg.num_workers == 1
+    verbs = [c[0] for c in cluster.calls]
+    assert verbs.count("apply") == 2 and "delete" in verbs
+    # The re-applied manifest carries the new world size.
+    last_apply = [c for c in cluster.calls if c[0] == "apply"][-1][1]
+    assert "completions: 1" in last_apply
+    assert "value: '1'" in last_apply      # TPUJOB_NUM_PROCESSES
+
+    # The checkpoint contract: the job re-renders the SAME name/namespace,
+    # so workers find their checkpoint dir again.
+    assert f"name: {cfg.name}" in last_apply
+
+
+def test_watch_timeout_counts_as_broken_gang():
+    """No Failed condition, no completion (the killed-pod/parked-peers
+    mode): the attempt timeout must trigger reconcile."""
+    cfg = JobConfig(num_workers=2)
+    hang = {"active": 2, "succeeded": 0}
+    cluster = FakeCluster([hang] * 15 + [{"active": 0, "succeeded": 1}])
+    result = _watch(cluster, cfg, attempt_timeout=10.0,
+                    resize=watch_mod.resize_to(1))
+    assert result.restarts >= 1
+    assert result.cfg.num_workers == 1
+
+
+def test_watch_exhausts_restarts():
+    cfg = JobConfig(num_workers=2)
+    fail = {"active": 0, "succeeded": 0, "failed": 4,
+            "conditions": [{"type": "Failed", "status": "True"}]}
+    cluster = FakeCluster([fail] * 10)
+    with pytest.raises(RuntimeError, match="failed 3 attempts"):
+        _watch(cluster, cfg, attempt_timeout=100.0, max_restarts=2)
+
+
+def test_watch_missing_job_is_not_complete():
+    """A deleted-out-from-under-us Job reads as not-exists (NotFound) and
+    ends in reconcile, not a crash."""
+    cfg = JobConfig(num_workers=1)
+    cluster = FakeCluster([None] * 4 + [{"active": 0, "succeeded": 1}])
+    result = _watch(cluster, cfg, attempt_timeout=3.0, max_restarts=1)
+    assert result.restarts == 1
+    assert result.status.succeeded == 1
