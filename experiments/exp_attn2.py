@@ -1,0 +1,42 @@
+"""Attention microbench: fwd vs bwd split; D=64 vs D=128; GQA vs MHA."""
+import sys, time, json
+sys.path.insert(0, "/root/repo")
+import jax, jax.numpy as jnp
+from k8s_distributed_deeplearning_tpu.ops.attention import multi_head_attention
+
+def timeit(fn, steps=15, warmup=2):
+    for _ in range(warmup):
+        out = fn()
+    float(out)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = fn()
+    float(out)
+    return (time.perf_counter() - t0) / steps * 1e3
+
+def bench(B, S, H, HKV, D, impl, mode):
+    ks = jax.random.split(jax.random.key(3), 3)
+    q = jax.random.normal(ks[0], (B, S, H, D), jnp.bfloat16)
+    k = jax.random.normal(ks[1], (B, S, HKV, D), jnp.bfloat16)
+    v = jax.random.normal(ks[2], (B, S, HKV, D), jnp.bfloat16)
+    if mode == "fwd":
+        f = jax.jit(lambda q, k, v: multi_head_attention(
+            q, k, v, causal=True, impl=impl).astype(jnp.float32).sum())
+    else:
+        f = jax.jit(lambda q, k, v: sum(
+            x.astype(jnp.float32).sum() for x in jax.grad(
+                lambda q, k, v: multi_head_attention(
+                    q, k, v, causal=True, impl=impl).astype(jnp.float32).sum(),
+                argnums=(0, 1, 2))(q, k, v)))
+    ms = timeit(lambda: f(q, k, v))
+    # causal fwd flops: 2 matmuls, half the square
+    flops = 4 * B * H * S * S * D / 2 * (1 if mode == "fwd" else 3.5)
+    print(json.dumps({"cfg": f"B{B} S{S} H{H}/{HKV} D{D} {impl} {mode}",
+                      "ms": round(ms, 2),
+                      "tflops": round(flops / ms / 1e9, 1)}), flush=True)
+
+bench(8, 2048, 12, 4, 64, "flash", "fwd")
+bench(8, 2048, 12, 12, 64, "flash", "fwd")   # no GQA expand
+bench(8, 2048, 6, 6, 128, "flash", "fwd")    # same flops, D=128
+bench(8, 2048, 6, 6, 128, "flash", "bwd")
+bench(8, 2048, 12, 4, 64, "flash", "bwd")

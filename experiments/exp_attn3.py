@@ -1,0 +1,55 @@
+"""Attention micro with dispatch amortized: 12 chained calls in one jit."""
+import sys, time, json
+sys.path.insert(0, "/root/repo")
+import jax, jax.numpy as jnp
+from k8s_distributed_deeplearning_tpu.ops.attention import multi_head_attention
+
+N = 12
+
+def timeit(fn, steps=10, warmup=2):
+    for _ in range(warmup):
+        out = fn()
+    float(out)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = fn()
+    float(out)
+    return (time.perf_counter() - t0) / steps * 1e3
+
+def bench(B, S, H, HKV, D, impl, mode):
+    ks = jax.random.split(jax.random.key(3), 3)
+    q = jax.random.normal(ks[0], (B, S, H, D), jnp.bfloat16)
+    k = jax.random.normal(ks[1], (B, S, HKV, D), jnp.bfloat16)
+    v = jax.random.normal(ks[2], (B, S, HKV, D), jnp.bfloat16)
+
+    def chain(q, k, v):
+        out = q
+        for _ in range(N):
+            out = multi_head_attention(out, k, v, causal=True, impl=impl)
+        return out.astype(jnp.float32).sum()
+
+    if mode == "fwd":
+        f = jax.jit(chain)
+    else:
+        f = jax.jit(lambda q, k, v: sum(
+            x.astype(jnp.float32).sum()
+            for x in jax.grad(chain, argnums=(0, 1, 2))(q, k, v)))
+    ms = timeit(lambda: f(q, k, v)) / N
+    flops = 4 * B * H * S * S * D / 2 * (1 if mode == "fwd" else 3.5)
+    print(json.dumps({"cfg": f"B{B} S{S} H{H}/{HKV} D{D} {impl} {mode}",
+                      "ms_per_call": round(ms, 3),
+                      "tflops": round(flops / ms / 1e9, 1)}), flush=True)
+
+import argparse
+ap = argparse.ArgumentParser()
+ap.add_argument("--set", type=int, default=0)
+a = ap.parse_args()
+if a.set == 0:
+    bench(8, 2048, 12, 4, 64, "flash", "fwd")
+    bench(8, 2048, 12, 4, 64, "flash", "bwd")
+elif a.set == 1:
+    bench(8, 2048, 12, 4, 64, "xla", "fwd")
+    bench(8, 2048, 12, 4, 64, "xla", "bwd")
+elif a.set == 2:
+    bench(8, 2048, 6, 6, 128, "flash", "fwd")
+    bench(8, 2048, 6, 6, 128, "flash", "bwd")

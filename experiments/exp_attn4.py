@@ -1,0 +1,42 @@
+"""Probes: D=128 equal-flops; non-causal; larger B scaling."""
+import sys, time, json
+sys.path.insert(0, "/root/repo")
+import jax, jax.numpy as jnp
+from k8s_distributed_deeplearning_tpu.ops import pallas_flash as pf
+
+N = 12
+
+def timeit(fn, steps=10, warmup=2):
+    for _ in range(warmup):
+        out = fn()
+    float(out)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = fn()
+    float(out)
+    return (time.perf_counter() - t0) / steps * 1e3
+
+def bench(B, S, H, HKV, D, causal, label):
+    ks = jax.random.split(jax.random.key(3), 3)
+    q = jax.random.normal(ks[0], (B, S, H, D), jnp.bfloat16)
+    k = jax.random.normal(ks[1], (B, S, HKV, D), jnp.bfloat16)
+    v = jax.random.normal(ks[2], (B, S, HKV, D), jnp.bfloat16)
+    def chain(q, k, v):
+        out = q
+        for _ in range(N):
+            out = pf.flash_attention(out, k, v, causal=causal)
+        return out.astype(jnp.float32).sum()
+    ms = timeit(lambda: jax.jit(chain)(q, k, v)) / N
+    flops = 4 * B * H * S * S * D / (2 if causal else 1)
+    print(json.dumps({"cfg": label, "fwd_ms": round(ms, 3),
+                      "tflops": round(flops / ms / 1e9, 1)}), flush=True)
+
+import argparse
+ap = argparse.ArgumentParser(); ap.add_argument("--set", type=int, default=0)
+a = ap.parse_args()
+if a.set == 0:
+    bench(8, 2048, 6, 6, 128, True, "B8 S2048 H6 D128 causal")
+    bench(8, 2048, 12, 4, 64, False, "B8 S2048 H12/4 D64 NONcausal")
+elif a.set == 1:
+    bench(4, 4096, 12, 4, 64, True, "B4 S4096 H12/4 D64 causal")
+    bench(32, 2048, 12, 4, 64, True, "B32 S2048 H12/4 D64 causal")

@@ -1,0 +1,54 @@
+"""Breakdown part 1: full step vs grad-only vs fwd-only vs hidden-only."""
+import sys, time, json
+sys.path.insert(0, "/root/repo")
+import jax, jax.numpy as jnp, optax
+from k8s_distributed_deeplearning_tpu.models import llama
+
+SEQ, B = 2048, 8
+TOK = B * SEQ
+cfg = llama.config_tiny(vocab_size=32000, dim=768, n_layers=12, n_heads=12,
+                        n_kv_heads=4, mlp_dim=2048, max_seq_len=SEQ,
+                        dtype=jnp.bfloat16, attention_impl="flash",
+                        remat=True, remat_policy="dots")
+model = llama.LlamaLM(cfg)
+params = model.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))["params"]
+toks = jax.random.randint(jax.random.key(1), (B, SEQ + 1), 0,
+                          cfg.vocab_size, dtype=jnp.int32)
+batch = {"tokens": toks}
+opt = optax.adamw(3e-4)
+opt_state = opt.init(params)
+
+
+def timeit(fn, steps=15, warmup=2):
+    for _ in range(warmup):
+        out = fn()
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = fn()
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / steps * 1e3
+
+
+def report(name, ms):
+    print(json.dumps({"what": name, "ms": round(ms, 2),
+                      "toks_per_s": round(TOK / ms * 1e3)}), flush=True)
+
+
+@jax.jit
+def full(params, opt_state):
+    g = jax.grad(lambda p: llama.loss_fn(model, p, batch)[0])(params)
+    up, new_os = opt.update(g, opt_state, params)
+    return optax.apply_updates(params, up), new_os
+
+report("full fwd+bwd+adamw", timeit(lambda: full(params, opt_state)))
+
+grad_fn = jax.jit(jax.grad(lambda p: llama.loss_fn(model, p, batch)[0]))
+report("fwd+bwd", timeit(lambda: grad_fn(params)))
+
+fwd = jax.jit(lambda p: llama.loss_fn(model, p, batch)[0])
+report("fwd", timeit(lambda: fwd(params)))
+
+hid = jax.jit(lambda p: model.apply({"params": p}, batch["tokens"][:, :-1],
+                                    return_hidden=True).astype(jnp.float32).sum())
+report("fwd hidden only", timeit(lambda: hid(params)))

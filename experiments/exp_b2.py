@@ -1,0 +1,32 @@
+"""Breakdown part 2 (honest sync): raw attention at real shapes."""
+import sys, time, json
+sys.path.insert(0, "/root/repo")
+import jax, jax.numpy as jnp
+from k8s_distributed_deeplearning_tpu.ops.attention import multi_head_attention
+
+SEQ, B = 2048, 8
+
+def timeit(fn, steps=15, warmup=2):
+    for _ in range(warmup):
+        out = fn()
+    float(out)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = fn()
+    float(out)
+    return (time.perf_counter() - t0) / steps * 1e3
+
+ks = jax.random.split(jax.random.key(3), 3)
+q = jax.random.normal(ks[0], (B, SEQ, 12, 64), jnp.bfloat16)
+k = jax.random.normal(ks[1], (B, SEQ, 4, 64), jnp.bfloat16)
+v = jax.random.normal(ks[2], (B, SEQ, 4, 64), jnp.bfloat16)
+for impl in ("flash", "xla"):
+    g = jax.jit(lambda q, k, v, _i=impl: sum(
+        x.astype(jnp.float32).sum() for x in jax.grad(
+            lambda q, k, v: multi_head_attention(
+                q, k, v, causal=True, impl=_i).astype(jnp.float32).sum(),
+            argnums=(0, 1, 2))(q, k, v)))
+    ms = timeit(lambda: g(q, k, v))
+    print(json.dumps({"what": f"attn fwd+bwd {impl}",
+                      "ms_one": round(ms, 2), "ms_x12": round(ms * 12, 1)}),
+          flush=True)

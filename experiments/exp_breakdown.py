@@ -1,0 +1,97 @@
+"""Where does the S=2048 llama-small step go? Empirical ablation:
+full step vs grad-only vs fwd-only vs attention-swap (flash->xla) vs
+loss-only-no-head. Also raw attention microbench at the real shapes
+(B=8, S=2048, H=12, Hkv=4, D=64).
+"""
+import sys, time, json
+sys.path.insert(0, "/root/repo")
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from k8s_distributed_deeplearning_tpu.models import llama
+from k8s_distributed_deeplearning_tpu.parallel import mesh as mesh_lib
+from k8s_distributed_deeplearning_tpu.parallel import sharding
+
+mesh = mesh_lib.make_mesh({"data": -1})
+SEQ, B = 2048, 8
+TOK = B * SEQ
+
+
+def timeit(fn, *a, steps=20, warmup=3):
+    for _ in range(warmup):
+        out = fn(*a)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = fn(*a)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / steps * 1e3  # ms
+
+
+def build(attention_impl="flash", remat=True):
+    cfg = llama.config_tiny(
+        vocab_size=32000, dim=768, n_layers=12, n_heads=12, n_kv_heads=4,
+        mlp_dim=2048, max_seq_len=SEQ, dtype=jnp.bfloat16,
+        attention_impl=attention_impl, remat=remat, remat_policy="dots")
+    model = llama.LlamaLM(cfg)
+    params = model.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    toks = jax.random.randint(jax.random.key(1), (B, SEQ + 1), 0,
+                              cfg.vocab_size, dtype=jnp.int32)
+    return cfg, model, params, {"tokens": toks}
+
+
+cfg, model, params, batch = build()
+opt = optax.adamw(3e-4)
+
+
+def report(name, ms):
+    print(json.dumps({"what": name, "ms": round(ms, 2),
+                      "toks_per_s": round(TOK / ms * 1e3)}), flush=True)
+
+
+# Full train step (via ShardedTrainer, same as bench)
+tr = sharding.ShardedTrainer(lambda p, b, r: llama.loss_fn(model, p, b, r),
+                             opt, mesh)
+state = tr.init(lambda r: params, jax.random.key(0))
+step = tr.make_step(donate=False)
+rng = jax.random.key(2)
+ms_full = timeit(lambda: step(state, tr.shard_batch(batch), rng)[1])
+report("full_step (fwd+bwd+adamw)", ms_full)
+
+# grad only (no optimizer update)
+grad_fn = jax.jit(jax.grad(lambda p: llama.loss_fn(model, p, batch)[0]))
+ms_grad = timeit(lambda: grad_fn(params))
+report("fwd+bwd only", ms_grad)
+
+# fwd only
+fwd = jax.jit(lambda p: llama.loss_fn(model, p, batch)[0])
+ms_fwd = timeit(lambda: fwd(params))
+report("fwd only", ms_fwd)
+
+# fwd without LM head/CE: hidden states only
+hid = jax.jit(lambda p: model.apply(
+    {"params": p}, batch["tokens"][:, :-1], return_hidden=True)
+    .astype(jnp.float32).sum())
+ms_hid = timeit(lambda: hid(params))
+report("fwd hidden only (no head/CE)", ms_hid)
+
+# attention swap: xla impl full grad
+cfg2, model2, params2, _ = build(attention_impl="xla")
+grad2 = jax.jit(jax.grad(lambda p: llama.loss_fn(model2, p, batch)[0]))
+ms_grad_xla = timeit(lambda: grad2(params2))
+report("fwd+bwd xla-attn", ms_grad_xla)
+
+# raw flash attention at real shapes, fwd+bwd
+from k8s_distributed_deeplearning_tpu.ops.attention import multi_head_attention
+ks = jax.random.split(jax.random.key(3), 3)
+q = jax.random.normal(ks[0], (B, SEQ, 12, 64), jnp.bfloat16)
+k = jax.random.normal(ks[1], (B, SEQ, 4, 64), jnp.bfloat16)
+v = jax.random.normal(ks[2], (B, SEQ, 4, 64), jnp.bfloat16)
+for impl in ("flash", "xla"):
+    g = jax.jit(jax.grad(lambda q, k, v, _i=impl: multi_head_attention(
+        q, k, v, causal=True, impl=_i).astype(jnp.float32).sum(),
+        argnums=(0, 1, 2)))
+    ms = timeit(lambda: g(q, k, v))
+    report(f"attn-only fwd+bwd {impl} x12layers", ms * 12)

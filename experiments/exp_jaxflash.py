@@ -1,0 +1,44 @@
+"""Calibration: jax.experimental pallas TPU flash attention at same shapes."""
+import sys, time, json
+sys.path.insert(0, "/root/repo")
+import jax, jax.numpy as jnp
+import functools
+
+N = 12
+B, S, H, D = 8, 2048, 12, 64
+
+from jax.experimental.pallas.ops.tpu.flash_attention import (
+    flash_attention, BlockSizes)
+
+def timeit(fn, steps=10, warmup=2):
+    for _ in range(warmup):
+        out = fn()
+    float(out)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = fn()
+    float(out)
+    return (time.perf_counter() - t0) / steps * 1e3
+
+ks = jax.random.split(jax.random.key(3), 3)
+# bundled kernel layout: [B, H, S, D]
+q = jax.random.normal(ks[0], (B, H, S, D), jnp.bfloat16)
+k = jax.random.normal(ks[1], (B, H, S, D), jnp.bfloat16)
+v = jax.random.normal(ks[2], (B, H, S, D), jnp.bfloat16)
+
+def chain(q, k, v):
+    out = q
+    for _ in range(N):
+        out = flash_attention(out, k, v, causal=True)
+    return out.astype(jnp.float32).sum()
+
+fwd = jax.jit(chain)
+ms_f = timeit(lambda: fwd(q, k, v)) / N
+print(json.dumps({"what": "jax bundled flash fwd", "ms": round(ms_f, 3)}),
+      flush=True)
+g = jax.jit(lambda q, k, v: sum(
+    x.astype(jnp.float32).sum()
+    for x in jax.grad(chain, argnums=(0, 1, 2))(q, k, v)))
+ms_g = timeit(lambda: g(q, k, v)) / N
+print(json.dumps({"what": "jax bundled flash fwd+bwd", "ms": round(ms_g, 3)}),
+      flush=True)

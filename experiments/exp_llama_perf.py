@@ -1,0 +1,68 @@
+"""Scratch experiment: llama-small S=2048 throughput under config variations.
+
+Levers: batch size, remat on/off + policy, steps-per-window. Prints one line
+per config with per-window tok/s so spread is visible.
+"""
+import sys, time, json
+sys.path.insert(0, "/root/repo")
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from k8s_distributed_deeplearning_tpu.models import llama
+from k8s_distributed_deeplearning_tpu.parallel import mesh as mesh_lib
+from k8s_distributed_deeplearning_tpu.parallel import sharding
+
+mesh = mesh_lib.make_mesh({"data": -1})
+SEQ = 2048
+
+
+def run(batch, steps=30, warmup=5, windows=5, **cfg_over):
+    base = dict(vocab_size=32000, dim=768, n_layers=12, n_heads=12,
+                n_kv_heads=4, mlp_dim=2048, max_seq_len=SEQ,
+                dtype=jnp.bfloat16, attention_impl="flash")
+    base.update(cfg_over)
+    cfg = llama.config_tiny(**base)
+    model = llama.LlamaLM(cfg)
+    tr = sharding.ShardedTrainer(
+        lambda p, b, r: llama.loss_fn(model, p, b, r),
+        optax.adamw(3e-4), mesh)
+    state = tr.init(lambda r: model.init(r, jnp.zeros((1, 8), jnp.int32))["params"],
+                    jax.random.key(0))
+    step = tr.make_step(donate=True)
+    toks = jax.random.randint(jax.random.key(1), (batch, SEQ + 1), 0,
+                              cfg.vocab_size, dtype=jnp.int32)
+    b = tr.shard_batch({"tokens": toks})
+    rng = jax.random.key(2)
+    for _ in range(warmup):
+        state, loss, _ = step(state, b, rng)
+    float(loss)
+    rates = []
+    for _ in range(windows):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, loss, _ = step(state, b, rng)
+        float(loss)
+        dt = time.perf_counter() - t0
+        rates.append(batch * SEQ * steps / dt)
+    rates = [round(r) for r in rates]
+    med = sorted(rates)[len(rates) // 2]
+    print(json.dumps({"batch": batch, **cfg_over, "median": med,
+                      "spread_pct": round(100 * (max(rates) - min(rates)) / med, 2),
+                      "windows": rates}), flush=True)
+
+
+for label, kw in [
+    ("b8 noremat", dict(batch=8)),
+    ("b16 noremat", dict(batch=16)),
+    ("b8 remat dots", dict(batch=8, remat=True, remat_policy="dots")),
+    ("b16 remat dots", dict(batch=16, remat=True, remat_policy="dots")),
+    ("b32 remat dots", dict(batch=32, remat=True, remat_policy="dots")),
+    ("b8 remat nothing", dict(batch=8, remat=True, remat_policy="nothing")),
+]:
+    print("#", label, flush=True)
+    try:
+        run(**kw)
+    except Exception as e:
+        print(json.dumps({"label": label, "error": repr(e)[:200]}), flush=True)

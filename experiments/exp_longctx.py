@@ -1,0 +1,45 @@
+"""Long-context S=8192 with the round-3 stack: scan vs unrolled, dots."""
+import sys, time, json
+sys.path.insert(0, "/root/repo")
+import jax, jax.numpy as jnp, optax
+from k8s_distributed_deeplearning_tpu.models import llama
+from k8s_distributed_deeplearning_tpu.parallel import mesh as mesh_lib
+from k8s_distributed_deeplearning_tpu.parallel import sharding
+
+mesh = mesh_lib.make_mesh({"data": -1})
+SEQ, B = 8192, 2
+
+def run(label, scan_layers):
+    cfg = llama.config_tiny(vocab_size=32000, dim=768, n_layers=12,
+                            n_heads=12, n_kv_heads=4, mlp_dim=2048,
+                            max_seq_len=SEQ, dtype=jnp.bfloat16,
+                            attention_impl="flash", remat=True,
+                            remat_policy="dots", scan_layers=scan_layers)
+    model = llama.LlamaLM(cfg)
+    tr = sharding.ShardedTrainer(
+        lambda p, b, r: llama.loss_fn(model, p, b, r, chunked=True),
+        optax.adamw(3e-4), mesh)
+    state = tr.init(lambda r: model.init(r, jnp.zeros((1, 8), jnp.int32))["params"],
+                    jax.random.key(0))
+    step = tr.make_step(donate=True)
+    toks = jax.random.randint(jax.random.key(1), (B, SEQ + 1), 0,
+                              cfg.vocab_size, dtype=jnp.int32)
+    b = tr.shard_batch({"tokens": toks})
+    rng = jax.random.key(2)
+    for _ in range(3):
+        state, loss, _ = step(state, b, rng)
+    float(loss)
+    rates = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(10):
+            state, loss, _ = step(state, b, rng)
+        float(loss)
+        rates.append(round(B * SEQ * 10 / (time.perf_counter() - t0)))
+    print(json.dumps({"label": label, "median": sorted(rates)[1],
+                      "windows": rates}), flush=True)
+
+import argparse
+ap = argparse.ArgumentParser(); ap.add_argument("--which", default="scan")
+w = ap.parse_args().which
+run(w, scan_layers=(w == "scan"))

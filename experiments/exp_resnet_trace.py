@@ -1,0 +1,32 @@
+import sys
+sys.path.insert(0, "/root/repo")
+sys.path.insert(0, "/root/repo/examples")
+import jax, jax.numpy as jnp, optax
+from k8s_distributed_deeplearning_tpu.models import resnet
+from k8s_distributed_deeplearning_tpu.parallel import data_parallel as dp
+from k8s_distributed_deeplearning_tpu.parallel import mesh as mesh_lib
+import train_zoo
+
+mesh = mesh_lib.make_mesh({"data": -1})
+model = resnet.resnet50(dtype=jnp.bfloat16)
+B = 128
+opt = optax.adam(1e-3)
+variables = model.init(jax.random.key(0), jnp.zeros((1, 224, 224, 3)), train=False)
+state = train_zoo.ResNetState(variables["params"], variables["batch_stats"],
+                              opt.init(variables["params"]),
+                              jnp.zeros((), jnp.int32))
+state = jax.device_put(state, jax.sharding.NamedSharding(
+    mesh, jax.sharding.PartitionSpec()))
+step = train_zoo.make_resnet_step(model, opt, mesh)
+batch = dp.shard_batch({
+    "image": jax.random.normal(jax.random.key(1), (B, 224, 224, 3), jnp.float32),
+    "label": jax.random.randint(jax.random.key(2), (B,), 0, 1000)}, mesh)
+for _ in range(4):
+    state, loss, _ = step(state, batch, jax.random.key(0))
+float(loss)
+jax.profiler.start_trace("/tmp/trace_resnet")
+for _ in range(3):
+    state, loss, _ = step(state, batch, jax.random.key(0))
+float(loss)
+jax.profiler.stop_trace()
+print("done")

@@ -1,0 +1,31 @@
+import sys, time, json
+sys.path.insert(0, "/root/repo")
+import jax, jax.numpy as jnp
+from k8s_distributed_deeplearning_tpu.ops import pallas_flash as pf
+
+B, S, H, D = 1, 32768, 8, 128
+ks = jax.random.split(jax.random.key(3), 3)
+q, k, v = (jax.random.normal(kk, (B, S, H, D), jnp.bfloat16) for kk in ks)
+
+def timeit(fn, steps=10, warmup=2):
+    for _ in range(warmup):
+        out = fn()
+    float(out)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = fn()
+    float(out)
+    return (time.perf_counter() - t0) / steps * 1e3
+
+N = 2
+def chain(q, k, v):
+    out = q
+    for _ in range(N):
+        out = pf.flash_attention(out, k, v, causal=True)
+    return out.astype(jnp.float32).sum()
+fwd = jax.jit(chain)
+g = jax.jit(lambda q, k, v: sum(
+    x.astype(jnp.float32).sum()
+    for x in jax.grad(chain, argnums=(0, 1, 2))(q, k, v)))
+print(json.dumps({"fwd_ms": round(timeit(lambda: fwd(q, k, v)) / N, 2),
+                  "fwdbwd_ms": round(timeit(lambda: g(q, k, v)) / N, 2)}))

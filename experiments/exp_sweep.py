@@ -1,0 +1,52 @@
+"""Sweep flash block sizes at B8 S2048 H12/4 D64, fwd + fwd/bwd, 12x chained."""
+import sys, time, json, argparse
+sys.path.insert(0, "/root/repo")
+import jax, jax.numpy as jnp
+from k8s_distributed_deeplearning_tpu.ops import pallas_flash as pf
+
+N = 12
+B, S, H, HKV, D = 8, 2048, 12, 4, 64
+
+def timeit(fn, steps=10, warmup=2):
+    for _ in range(warmup):
+        out = fn()
+    float(out)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = fn()
+    float(out)
+    return (time.perf_counter() - t0) / steps * 1e3
+
+ks = jax.random.split(jax.random.key(3), 3)
+q = jax.random.normal(ks[0], (B, S, H, D), jnp.bfloat16)
+k = jax.random.normal(ks[1], (B, S, HKV, D), jnp.bfloat16)
+v = jax.random.normal(ks[2], (B, S, HKV, D), jnp.bfloat16)
+
+def chain(q, k, v):
+    out = q
+    for _ in range(N):
+        out = pf.flash_attention(out, k, v, causal=True)
+    return out.astype(jnp.float32).sum()
+
+def run(bq, bk):
+    pf._BLOCK_Q, pf._BLOCK_K = bq, bk
+    fwd = jax.jit(chain)
+    g = jax.jit(lambda q, k, v: sum(
+        x.astype(jnp.float32).sum()
+        for x in jax.grad(chain, argnums=(0, 1, 2))(q, k, v)))
+    ms_f = timeit(lambda: fwd(q, k, v)) / N
+    ms_g = timeit(lambda: g(q, k, v)) / N
+    print(json.dumps({"bq": bq, "bk": bk, "fwd_ms": round(ms_f, 3),
+                      "fwdbwd_ms": round(ms_g, 3)}), flush=True)
+
+ap = argparse.ArgumentParser()
+ap.add_argument("--set", type=int, default=0)
+a = ap.parse_args()
+grids = {
+    0: [(512, 512), (1024, 512)],
+    1: [(2048, 512), (1024, 1024)],
+    2: [(512, 1024), (2048, 2048)],
+    3: [(256, 512), (1024, 2048)],
+}[a.set]
+for bq, bk in grids:
+    run(bq, bk)

@@ -46,6 +46,12 @@ class Checkpointer:
         self.keep_best_metric = keep_best_metric
         self.async_save = async_save
         if keep_best_metric is not None:
+            # orbax doesn't re-export preservation policies at top level;
+            # `orbax.checkpoint.checkpoint_managers` is the most public
+            # path that carries them (not `_src`, but version-sensitive —
+            # verified on orbax-checkpoint 0.11.x, and the LatestN+BestN
+            # semantics are pinned by tests/test_checkpoint.py, which is
+            # the tripwire if an upgrade moves or reshapes this API).
             from orbax.checkpoint.checkpoint_managers import (
                 preservation_policy as pp)
             metric_fn = lambda m: float(m[keep_best_metric])
