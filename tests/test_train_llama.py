@@ -99,13 +99,17 @@ def test_train_llama_packed_cli(tmp_path):
     assert result["num_steps"] == 10
 
 
-def test_train_llama_pack_flag_conflicts():
-    # --pack + --pp is supported since round 3 (packed pipeline path);
-    # context-parallel attention remains the conflicting combination.
+def test_train_llama_pack_composes_with_context_parallel(tmp_path):
+    """--pack + context-parallel trains since round 4 (segment-aware ring
+    attention: ids ride the rotation) — the former ValueError guard is a
+    working path now."""
     import train_llama
-    with pytest.raises(ValueError, match="--pack"):
-        train_llama.main(["--preset", "tiny", "--pack", "--sp", "2",
-                          "--attention", "ring", "--num-steps", "1"])
+    result = train_llama.main([
+        "--preset", "tiny", "--pack", "--sp", "2", "--dp", "4",
+        "--attention", "ring", "--num-steps", "2", "--batch-size", "8",
+        "--seq-len", "64", "--no-eval", "--prefetch", "0",
+        "--checkpoint-dir", str(tmp_path / "ck")])
+    assert result["num_steps"] == 2
 
 
 def test_train_llama_pp_flag_conflicts():
