@@ -1,0 +1,139 @@
+"""Perf probe: does fusing parallel matmuls that share an operand win on TPU?
+
+Three candidates (all fwd+bwd, flagship/bench shapes, bf16):
+  1. MoE expert MLP: separate gate/up einsums vs one fused [E,d,2m] einsum.
+  2. Dense attention QKV: three matmuls vs one fused KV-head-major
+     [d, hkv, (g+2), hd] matmul (group-aligned so TP sharding still works).
+  3. Dense SwiGLU MLP: separate gate/up vs fused [d, 2*mlp].
+
+Timing note: identical repeated dispatches are served without re-execution
+through this environment's device tunnel (a no-chain probe measured an
+impossible 40 PFLOP/s), so every iteration CHAINS its input on the previous
+gradient — same trick bench.py's step-threading uses.
+
+Run on the real chip: python experiments/fused_matmul_probe.py
+Not product code (see experiments/README.md).
+
+RESULT (2026-07-31, v5e-1): isolated fwd+bwd at bench shapes —
+  moe separate 7.01 ms vs fused 5.41 ms (-23%)
+  qkv separate 4.05 ms vs fused 3.89 ms (-4%)
+  mlp separate 4.51 ms vs fused 4.57 ms (flat)
+BUT the MoE win did NOT transfer to the full model: bench.py --suite moe
+same-day A/B measured unfused 62.8k tok/s vs fused 55.5k (-12%), and a
+concat-at-apply variant (fused dot, separate params) 57.5k — the fused dot
+itself is slower in context (remat + surrounding dispatch/optimizer change
+XLA's schedule). Fusion was REVERTED; don't retry without profiling the
+full step. See BENCHMARKS.md MoE notes.
+"""
+import time
+
+import jax
+import jax.numpy as jnp
+
+E, C, D, M = 8, 2560, 768, 2048
+B, S = 8, 2048
+HQ, HKV, HD = 12, 4, 64
+G = HQ // HKV
+bf = jnp.bfloat16
+key = jax.random.key(0)
+
+
+def timeit_chained(grad_fn, args, n=30, warmup=5):
+    """args[0] is chained: x <- x + eps * dx so no two dispatches match."""
+    def run(args):
+        g = grad_fn(*args)
+        return (args[0] + 1e-6 * g[0].astype(args[0].dtype),) + args[1:]
+    for _ in range(warmup):
+        args = run(args)
+    float(jnp.sum(args[0].astype(jnp.float32)))
+    t0 = time.perf_counter()
+    for _ in range(n):
+        args = run(args)
+    # block_until_ready through the device tunnel acks before execution
+    # finishes (measured >peak-FLOPs "speeds") — a host fetch of a value
+    # depending on the whole chain is the only real barrier.
+    float(jnp.sum(args[0].astype(jnp.float32)))
+    return (time.perf_counter() - t0) / n * 1e3  # ms
+
+
+def gradded(f, nargs):
+    return jax.jit(jax.grad(
+        lambda *a: jnp.sum(f(*a).astype(jnp.float32) ** 2),
+        argnums=tuple(range(nargs))))
+
+
+# ---- 1. MoE expert MLP ----------------------------------------------------
+xe = jax.random.normal(key, (E, C, D), bf)
+wg = jax.random.normal(key, (E, D, M), bf)
+wu = jax.random.normal(key, (E, D, M), bf)
+wgu = jnp.concatenate([wg, wu], axis=-1)
+wd = jax.random.normal(key, (E, M, D), bf)
+
+
+def moe_sep(xe, wg, wu, wd):
+    h = jax.nn.silu(jnp.einsum("ecd,edm->ecm", xe, wg)) \
+        * jnp.einsum("ecd,edm->ecm", xe, wu)
+    return jnp.einsum("ecm,emd->ecd", h, wd)
+
+
+def moe_fused(xe, wgu, wd):
+    hh = jnp.einsum("ecd,edm->ecm", xe, wgu)
+    h = jax.nn.silu(hh[..., :M]) * hh[..., M:]
+    return jnp.einsum("ecm,emd->ecd", h, wd)
+
+
+flop = 3 * 2 * E * C * D * M * 3  # 3 matmuls, fwd+2bwd
+t = timeit_chained(gradded(moe_sep, 4), (xe, wg, wu, wd))
+print(f"moe separate fwd+bwd: {t:.3f} ms  ({flop/(t/1e3)/1e12:.0f} TF/s)")
+t = timeit_chained(gradded(moe_fused, 3), (xe, wgu, wd))
+print(f"moe fused    fwd+bwd: {t:.3f} ms  ({flop/(t/1e3)/1e12:.0f} TF/s)")
+
+# ---- 2. QKV projection ----------------------------------------------------
+x = jax.random.normal(key, (B, S, D), bf)
+wq = jax.random.normal(key, (D, HQ, HD), bf)
+wk = jax.random.normal(key, (D, HKV, HD), bf)
+wv = jax.random.normal(key, (D, HKV, HD), bf)
+wqkv = jnp.concatenate([
+    wq.reshape(D, HKV, G, HD), wk.reshape(D, HKV, 1, HD),
+    wv.reshape(D, HKV, 1, HD)], axis=2)
+
+
+def qkv_sep(x, wq, wk, wv):
+    q = jnp.einsum("bsd,dhk->bshk", x, wq)
+    k = jnp.einsum("bsd,dhk->bshk", x, wk)
+    v = jnp.einsum("bsd,dhk->bshk", x, wv)
+    return q + 0.5 * k.repeat(G, axis=2) + 0.25 * v.repeat(G, axis=2)
+
+
+def qkv_fused(x, wqkv):
+    qkv = jnp.einsum("bsd,dhgk->bshgk", x, wqkv)
+    q = qkv[..., :G, :].reshape(B, S, HQ, HD)
+    return (q + 0.5 * qkv[..., G, :].repeat(G, axis=2)
+            + 0.25 * qkv[..., G + 1, :].repeat(G, axis=2))
+
+
+t = timeit_chained(gradded(qkv_sep, 4), (x, wq, wk, wv))
+print(f"qkv separate fwd+bwd: {t:.3f} ms")
+t = timeit_chained(gradded(qkv_fused, 2), (x, wqkv))
+print(f"qkv fused    fwd+bwd: {t:.3f} ms")
+
+# ---- 3. Dense SwiGLU gate+up ---------------------------------------------
+w1 = jax.random.normal(key, (D, M), bf)
+w2 = jax.random.normal(key, (D, M), bf)
+w12 = jnp.concatenate([w1, w2], axis=-1)
+w3 = jax.random.normal(key, (M, D), bf)
+
+
+def mlp_sep(x, w1, w2, w3):
+    return (jax.nn.silu(x @ w1) * (x @ w2)) @ w3
+
+
+def mlp_fused(x, w12, w3):
+    hh = x @ w12
+    return (jax.nn.silu(hh[..., :M]) * hh[..., M:]) @ w3
+
+
+t = timeit_chained(gradded(mlp_sep, 4), (x, w1, w2, w3))
+print(f"mlp separate fwd+bwd: {t:.3f} ms")
+t = timeit_chained(gradded(mlp_fused, 3), (x, w12, w3))
+print(f"mlp fused    fwd+bwd: {t:.3f} ms")
