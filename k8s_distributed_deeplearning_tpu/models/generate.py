@@ -155,6 +155,15 @@ def _generate(model, params: PyTree, prompt: jax.Array,
     b, s = prompt.shape
     prefill_kw: dict = {}
     lens = None
+    # Learned-position models need explicit positions at EMBED time (the
+    # cache cursor lives inside Attention, models/transformer.py decode
+    # branch): prefill is 0..s-1, decode step t sits at absolute s+t. RoPE
+    # models derive positions from the cursor internally. The left-padded
+    # branch below overrides both with per-row real-token positions.
+    learned = getattr(getattr(model, "cfg", None), "position",
+                      None) == "learned"
+    if learned:
+        prefill_kw = dict(positions=jnp.arange(s)[None, :])
     if prompt_mask is not None:
         # Left-padded batch: RoPE positions count REAL tokens (pads don't
         # advance a row's position), and the mask rides into the cache as
@@ -196,6 +205,10 @@ def _generate(model, params: PyTree, prompt: jax.Array,
             # models/transformer.py decode branch).
             step_kw["positions"] = (lens + t)[:, None]
             step_kw["segment_ids"] = jnp.ones((b, 1), jnp.int32)
+        elif learned:
+            # Unpadded learned-position decode: step t's token occupies
+            # absolute slot s + t (prefill filled 0..s-1).
+            step_kw["positions"] = jnp.full((b, 1), s + t, jnp.int32)
         logits, vars_ = model.apply({"params": params, "cache": cache},
                                     token[:, None], decode=True,
                                     mutable=["cache"], **step_kw)

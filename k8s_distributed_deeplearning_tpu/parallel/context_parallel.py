@@ -44,18 +44,31 @@ from jax.sharding import PartitionSpec as P
 NEG_INF = -1e30  # large-but-finite: avoids inf-inf NaNs in online softmax
 
 
-def _block_attend(q, k, v, mask, softmax_scale):
+def _apply_mask4(s, mask4):
+    """Apply a user mask block [B, 1|H, Sq, Sk] (bool or additive float) to
+    f32 scores [B, H, Sq, Sk]. Additive masks are clamped at NEG_INF so a
+    caller's -inf entries can't poison the online softmax with NaNs."""
+    if mask4.dtype == jnp.bool_:
+        return jnp.where(mask4, s, NEG_INF)
+    return jnp.maximum(s + mask4.astype(jnp.float32), NEG_INF)
+
+
+def _block_attend(q, k, v, mask, softmax_scale, mask4=None):
     """One blockwise attention step -> (block_out, block_rowsum, block_rowmax).
 
     q: [B,Sq,H,D]; k/v: [B,Sk,H,D]; mask: [Sq,Sk] or [B,Sq,Sk] bool or
-    None. Returns f32 (o_block unnormalized, l row-sums, m row-maxes) per
-    flash attention: softmax deferred until all blocks are merged.
+    None (the ring's own causal/segment mask); mask4: [B, 1|H, Sq, Sk]
+    caller mask block (bool or additive). Returns f32 (o_block
+    unnormalized, l row-sums, m row-maxes) per flash attention: softmax
+    deferred until all blocks are merged.
     """
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
                    preferred_element_type=jnp.float32) * softmax_scale
     if mask is not None:
         m_ = mask[None, None] if mask.ndim == 2 else mask[:, None]
         s = jnp.where(m_, s, NEG_INF)
+    if mask4 is not None:
+        s = _apply_mask4(s, mask4)
     m = jnp.max(s, axis=-1)                        # [B,H,Sq]
     p = jnp.exp(s - m[..., None])
     p = jnp.where(s <= NEG_INF / 2, 0.0, p)        # fully-masked rows -> 0
@@ -70,7 +83,7 @@ def _repeat_kv(x, n_rep):
 
 
 def _ring_fwd_loop(q, k, v, axis_name, causal, scale,
-                   segq=None, segk=None):
+                   segq=None, segk=None, maskq=None):
     """The forward rotation loop -> (out [B,Sq,H,D] in q.dtype,
     lse [B,H,Sq] f32). lse = m + log(l) is the flash-attention
     log-normalizer the backward uses to recompute every P block.
@@ -80,6 +93,13 @@ def _ring_fwd_loop(q, k, v, axis_name, causal, scale,
     their K/V shard, and each block's mask is causal ∧ segment-equal
     inside the online-softmax accumulate. Fully-masked blocks contribute
     exact zeros (the NEG_INF guard in _block_attend).
+
+    General masks: *maskq* is this device's ROW SHARD of the caller mask,
+    [B, 1|H, Sq_local, S_global] (bool or additive) — rows travel with the
+    queries, all key columns stay resident, and step t slices the source
+    shard's column block. O(Sq_local · S_global) per device: unlike the
+    score blocks this grows with global S (an arbitrary mask has no
+    structure to compress), which is the caller's memory trade.
 
     Written as ``lax.scan`` over the ring steps so per-step score blocks are
     provably reused (unrolling let the scheduler keep ~2 [B,H,Sq,Sk]
@@ -95,6 +115,13 @@ def _ring_fwd_loop(q, k, v, axis_name, causal, scale,
     sq, sk = q.shape[1], k.shape[1]
     b, h = q.shape[0], hq
     segments = segq is not None
+    if maskq is not None and maskq.shape[2:] != (sq, n * sk):
+        # dynamic_slice CLAMPS out-of-range starts, so a local-shaped mask
+        # (the natural mistake: q/k/v are all local shards) would silently
+        # reuse wrong column blocks instead of erroring.
+        raise ValueError(
+            f"ring mask must be the ROW shard [B, 1|H, S_local_q="
+            f"{sq}, S_global_kv={n * sk}], got {maskq.shape}")
 
     row = lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
     col = lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
@@ -110,13 +137,19 @@ def _ring_fwd_loop(q, k, v, axis_name, causal, scale,
             mask = seg_eq if mask is None else seg_eq & mask[None]
         return mask
 
+    def mask4_block(src):
+        if maskq is None:
+            return None
+        return lax.dynamic_slice_in_dim(maskq, src * sk, sk, axis=3)
+
     def step(carry, t):
         o, l, m, k, v, segk_t = carry
         # Rotation sends shard i to i-1, so at step t we hold rank (r+t)%n's KV.
         src = (r + t) % n
         bo, bl, bm = _block_attend(q, _repeat_kv(k, g_rep),
                                    _repeat_kv(v, g_rep),
-                                   block_mask(src, segk_t), scale)
+                                   block_mask(src, segk_t), scale,
+                                   mask4_block(src))
         m_new = jnp.maximum(m, bm)
         alpha = jnp.exp(m - m_new)        # rescale old accumulator
         beta = jnp.exp(bm - m_new)        # rescale incoming block
@@ -147,18 +180,21 @@ def _ring_fwd_loop(q, k, v, axis_name, causal, scale,
     return out, m + jnp.log(norm)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
-def _ring(q, k, v, segq, segk, axis_name, causal, scale):
-    return _ring_fwd_loop(q, k, v, axis_name, causal, scale, segq, segk)[0]
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8))
+def _ring(q, k, v, segq, segk, maskq, axis_name, causal, scale):
+    return _ring_fwd_loop(q, k, v, axis_name, causal, scale, segq, segk,
+                          maskq)[0]
 
 
-def _ring_vjp_fwd(q, k, v, segq, segk, axis_name, causal, scale):
-    out, lse = _ring_fwd_loop(q, k, v, axis_name, causal, scale, segq, segk)
-    # Residuals are O(S_local): the local shards + (o, lse). Without this
-    # custom VJP, autodiff saves every ring step's [B,H,Sq,Sk] probability
-    # block — backward memory O(S_local x S_global), exactly what ring
-    # attention exists to avoid.
-    return out, (q, k, v, segq, segk, out, lse)
+def _ring_vjp_fwd(q, k, v, segq, segk, maskq, axis_name, causal, scale):
+    out, lse = _ring_fwd_loop(q, k, v, axis_name, causal, scale, segq, segk,
+                              maskq)
+    # Residuals are O(S_local): the local shards + (o, lse) (+ the caller's
+    # mask row-shard, which is O(Sq_local x S_global) by its nature).
+    # Without this custom VJP, autodiff saves every ring step's [B,H,Sq,Sk]
+    # probability block — backward memory O(S_local x S_global) in SCORES,
+    # exactly what ring attention exists to avoid.
+    return out, (q, k, v, segq, segk, maskq, out, lse)
 
 
 def _ring_vjp_bwd(axis_name, causal, scale, res, do):
@@ -166,8 +202,9 @@ def _ring_vjp_bwd(axis_name, causal, scale, res, do):
     recomputes its P block from (q, k_t, lse), accumulates dq locally, and
     accumulates dk/dv into buffers that TRAVEL WITH the K/V shards — after
     n rotations the shards and their gradients arrive home together.
-    Segment ids (when present) re-ride the rotation exactly as forward."""
-    q, k, v, segq, segk, out, lse = res
+    Segment ids (when present) re-ride the rotation exactly as forward;
+    the caller mask's columns are re-sliced per source shard."""
+    q, k, v, segq, segk, maskq, out, lse = res
     n = lax.axis_size(axis_name)
     r = lax.axis_index(axis_name)
     hq, hkv = q.shape[2], k.shape[2]
@@ -183,9 +220,16 @@ def _ring_vjp_bwd(axis_name, causal, scale, res, do):
     row = lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
     col = lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
     shift_perm = [(i, (i - 1) % n) for i in range(n)]
+    masked = maskq is not None
+    # Additive (float) masks are differentiable — T5/ALiBi-style learned
+    # biases ride the mask argument — so the backward must produce TRUE
+    # cotangents (the Ulysses path gets them from plain autodiff; silently
+    # zeroing here would freeze a trained bias only under impl="ring").
+    # Bool masks are genuinely non-differentiable (float0 below).
+    masked_float = masked and maskq.dtype != jnp.bool_
 
     def step(carry, t):
-        dq, dk, dv, k, v, segk_t = carry
+        dq, dk, dv, dmask, k, v, segk_t = carry
         src = (r + t) % n
         ke = _repeat_kv(k, g_rep)
         ve = _repeat_kv(v, g_rep)
@@ -197,20 +241,36 @@ def _ring_vjp_bwd(axis_name, causal, scale, res, do):
         if segments:
             seg_eq = segq[:, :, None] == segk_t[:, None, :]   # [B,Sq,Sk]
             s = jnp.where(seg_eq[:, None], s, NEG_INF)
+        if masked:
+            s = _apply_mask4(
+                s, lax.dynamic_slice_in_dim(maskq, src * sk, sk, axis=3))
         # exp(NEG_INF - lse) underflows to exact 0 when lse is finite
         # (causal rows always see their own diagonal position). A FULLY
-        # masked row (possible only under segment masking with a q-side id
-        # absent from the kv side) has lse ~ NEG_INF, where exp(s - lse)
-        # would EXPLODE instead — force exact zeros for that case.
+        # masked row (possible under segment masking with a q-side id
+        # absent from the kv side, or under a caller mask) has
+        # lse ~ NEG_INF, where exp(s - lse) would EXPLODE instead — force
+        # exact zeros for that case.
         p = jnp.exp(s - lse[..., None])
-        if segments:
+        if segments or masked:
             p = jnp.where(s <= NEG_INF / 2, 0.0, p)
         pc = p.astype(do.dtype)
         dv_t = jnp.einsum("bhqk,bqhd->bkhd", pc, do,
                           preferred_element_type=jnp.float32)
         dp = jnp.einsum("bqhd,bkhd->bhqk", do, ve,
                         preferred_element_type=jnp.float32)
-        ds = (p * (dp - delta[..., None]) * scale).astype(q.dtype)
+        dpd = p * (dp - delta[..., None])          # cotangent of the scores
+        if masked_float:
+            # d(s)/d(mask) = 1 where the NEG_INF clamp is inactive; p is
+            # already exact-zero there, so dpd needs no extra masking.
+            # Each ring step owns a distinct column block (src visits each
+            # shard once per pass), so a plain slice-write accumulates the
+            # full row-shard cotangent over the loop.
+            dm_t = dpd
+            if maskq.shape[1] == 1:                # broadcast head dim
+                dm_t = dm_t.sum(axis=1, keepdims=True)
+            dmask = lax.dynamic_update_slice(
+                dmask, dm_t.astype(dmask.dtype), (0, 0, 0, src * sk))
+        ds = (dpd * scale).astype(q.dtype)
         dq = dq + jnp.einsum("bhqk,bkhd->bqhd", ds, ke,
                              preferred_element_type=jnp.float32)
         dk_t = jnp.einsum("bhqk,bqhd->bkhd", ds, q,
@@ -228,20 +288,28 @@ def _ring_vjp_bwd(axis_name, causal, scale, res, do):
         v = lax.ppermute(v, axis_name, shift_perm)
         if segments:
             segk_t = lax.ppermute(segk_t, axis_name, shift_perm)
-        return (dq, dk, dv, k, v, segk_t), None
+        return (dq, dk, dv, dmask, k, v, segk_t), None
 
     dq0 = jnp.zeros((b, sq, hq, d), jnp.float32)
     dk0 = jnp.zeros((b, sk, hkv, d), jnp.float32)
     dv0 = jnp.zeros((b, sk, hkv, d), jnp.float32)
+    dmask0 = (jnp.zeros(maskq.shape, jnp.float32) if masked_float
+              else jnp.zeros((), jnp.int32))
     segk0 = segk if segments else jnp.zeros((), jnp.int32)
-    (dq, dk, dv, _, _, _), _ = lax.scan(step, (dq0, dk0, dv0, k, v, segk0),
-                                        jnp.arange(n))
+    (dq, dk, dv, dmask_acc, _, _, _), _ = lax.scan(
+        step, (dq0, dk0, dv0, dmask0, k, v, segk0), jnp.arange(n))
 
     import numpy as np
     dseg = None if segq is None else np.zeros(segq.shape, jax.dtypes.float0)
     dsegk = None if segk is None else np.zeros(segk.shape, jax.dtypes.float0)
+    if maskq is None:
+        dmask = None
+    elif maskq.dtype == jnp.bool_:
+        dmask = np.zeros(maskq.shape, jax.dtypes.float0)
+    else:
+        dmask = dmask_acc.astype(maskq.dtype)
     return (dq.astype(q.dtype), dk.astype(res[1].dtype),
-            dv.astype(res[2].dtype), dseg, dsegk)
+            dv.astype(res[2].dtype), dseg, dsegk, dmask)
 
 
 _ring.defvjp(_ring_vjp_fwd, _ring_vjp_bwd)
@@ -251,7 +319,8 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                    axis_name: str = "sequence", causal: bool = True,
                    softmax_scale: float | None = None,
                    q_segment_ids: jax.Array | None = None,
-                   kv_segment_ids: jax.Array | None = None) -> jax.Array:
+                   kv_segment_ids: jax.Array | None = None,
+                   mask: jax.Array | None = None) -> jax.Array:
     """Exact attention over a sequence-sharded QKV, inside ``shard_map``.
 
     q/k/v: this device's sequence shard, [B, S_local, H(q|kv), D]. Output has
@@ -263,6 +332,18 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     K-side ids ride the ring rotation with their shard and every block's
     mask composes causal ∧ segment-equal — packed long-document training
     works over the sequence axis.
+
+    ``mask`` is this device's ROW SHARD of a general caller mask,
+    [B, 1|H, S_local_q, S_global_kv], bool (True = attend) or additive
+    float — prefix-LM / arbitrary-pattern masks over the sequence axis.
+    Rows travel with the queries; each ring step slices the source shard's
+    column block locally (the mask never rotates). Per-device mask memory
+    is O(S_local·S_global) — arbitrary masks have no structure to
+    compress; prefer causal/segment arguments when they express the
+    pattern. Composes with both (causal ∧ segments ∧ mask). Additive
+    masks get TRUE cotangents (a T5/ALiBi-style learned bias trains
+    identically under ring, Ulysses, or no CP — parity-tested); bool
+    masks are non-differentiable (float0).
 
     Differentiation goes through a custom VJP (``_ring_vjp_bwd``) that
     re-rotates K/V and recomputes each P block from the saved (q, k, lse) —
@@ -276,9 +357,18 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     if q_segment_ids is not None:
         q_segment_ids = q_segment_ids.astype(jnp.int32)
         kv_segment_ids = kv_segment_ids.astype(jnp.int32)
+    if mask is not None:
+        if mask.ndim != 4:
+            raise ValueError(
+                f"mask must be [B, 1|H, S_local_q, S_global_kv], got "
+                f"shape {mask.shape}")
+        if mask.shape[1] not in (1, q.shape[2]):
+            raise ValueError(
+                f"mask head dim must be 1 or {q.shape[2]}, got "
+                f"{mask.shape[1]}")
     scale = softmax_scale if softmax_scale is not None else q.shape[-1] ** -0.5
-    return _ring(q, k, v, q_segment_ids, kv_segment_ids, axis_name, causal,
-                 scale)
+    return _ring(q, k, v, q_segment_ids, kv_segment_ids, mask, axis_name,
+                 causal, scale)
 
 
 def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
@@ -286,7 +376,8 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                       softmax_scale: float | None = None,
                       inner: Callable | None = None,
                       q_segment_ids: jax.Array | None = None,
-                      kv_segment_ids: jax.Array | None = None) -> jax.Array:
+                      kv_segment_ids: jax.Array | None = None,
+                      mask: jax.Array | None = None) -> jax.Array:
     """All-to-all sequence parallelism (DeepSpeed-Ulysses scheme), inside
     ``shard_map``: redistribute [B, S/N, H, D] -> [B, S, H/N, D], attend over
     the full sequence locally, redistribute back. Requires H % N == 0.
@@ -295,6 +386,14 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     FULL sequence, so the [B, S_local] id shards are all-gathered along the
     sequence axis (tiny int32 traffic) and passed to the inner attention as
     its segment mask.
+
+    General masks: ``mask`` is the FULL caller mask [B, 1|H_global, S, S]
+    (bool or additive), replicated per device (Ulysses devices attend the
+    full sequence anyway, so the mask can't shard over S; per-head masks
+    get their local head block sliced). O(S²) per device — Ulysses is the
+    moderate-S scheme, ring shards the mask rows for long S. A general
+    mask routes the inner attention through the XLA reference path (the
+    flash kernel consumes only causal/segment structure).
     """
     if (q_segment_ids is None) != (kv_segment_ids is None):
         raise ValueError("q_segment_ids and kv_segment_ids must be given "
@@ -303,6 +402,11 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     hq, hkv = q.shape[2], k.shape[2]
     if hq % n:
         raise ValueError(f"ulysses needs heads {hq} divisible by axis size {n}")
+    if mask is not None:
+        if mask.ndim != 4 or mask.shape[1] not in (1, hq):
+            raise ValueError(
+                f"mask must be [B, 1|{hq}, S, S], got shape "
+                f"{getattr(mask, 'shape', None)}")
     if hkv != hq and hkv % n:
         # KV heads don't split across the axis: expand before the all-to-all
         # (pays the expansion bandwidth in the redistribute — unavoidable).
@@ -326,6 +430,29 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
         # head blocks and _repeat_kv repeats each kv head consecutively.
         kg = _repeat_kv(kg, hq // hkv)
         vg = _repeat_kv(vg, hq // hkv)
+    if mask is not None:
+        if mask.shape[1] == hq:
+            # Per-head mask: this device owns head block r after the
+            # all-to-all.
+            r = lax.axis_index(axis_name)
+            mask = lax.dynamic_slice_in_dim(mask, r * (hq // n), hq // n,
+                                            axis=1)
+        comb = mask
+        if q_segment_ids is not None:
+            from k8s_distributed_deeplearning_tpu.ops.attention import (
+                segment_mask)
+            sm = segment_mask(
+                lax.all_gather(q_segment_ids.astype(jnp.int32), axis_name,
+                               axis=1, tiled=True),
+                lax.all_gather(kv_segment_ids.astype(jnp.int32), axis_name,
+                               axis=1, tiled=True))
+            comb = (comb & sm if comb.dtype == jnp.bool_
+                    else comb + jnp.where(sm, 0.0, NEG_INF))
+        from k8s_distributed_deeplearning_tpu.ops.attention import (
+            dot_product_attention)
+        out = dot_product_attention(qg, kg, vg, causal=causal,
+                                    softmax_scale=softmax_scale, mask=comb)
+        return heads_to_seq(out)
     if q_segment_ids is not None:
         segq_full = lax.all_gather(q_segment_ids.astype(jnp.int32),
                                    axis_name, axis=1, tiled=True)
@@ -377,29 +504,42 @@ def make_context_parallel_attention(
     batch = tuple(a for a in batch_axes if a in mesh.axis_names)
     spec = P(batch or None, axis_name, None, None)
     seg_spec = P(batch or None, axis_name)
+    # General masks [B, 1|H, Sq, Sk]: ring wants the ROWS sharded with the
+    # queries (each device holds its q rows x all kv columns, O(S²/N));
+    # Ulysses attends the full sequence per device, so the mask replicates
+    # over the sequence axis (O(S²) — the moderate-S trade).
+    mask_spec = (P(batch or None, None, axis_name, None) if impl == "ring"
+                 else P(batch or None, None, None, None))
 
     def attention_fn(q, k, v, *, causal=True, mask=None, softmax_scale=None,
                      segment_ids=None):
+        if mask is not None and mask.ndim != 4:
+            raise ValueError(
+                f"context-parallel mask must be [B, 1|H, Sq, Sk], got "
+                f"shape {mask.shape}")
+
+        def inner_fn(q_, k_, v_, *rest):
+            rest = list(rest)
+            kw = dict(axis_name=axis_name, causal=causal,
+                      softmax_scale=softmax_scale)
+            if segment_ids is not None:
+                seg = rest.pop(0)
+                kw.update(q_segment_ids=seg, kv_segment_ids=seg)
+            if mask is not None:
+                kw["mask"] = rest.pop(0)
+            return fn(q_, k_, v_, **kw)
+
+        in_specs = [spec, spec, spec]
+        extras = []
+        if segment_ids is not None:
+            in_specs.append(seg_spec)
+            extras.append(segment_ids)
         if mask is not None:
-            raise NotImplementedError(
-                "context-parallel attention supports causal and segment "
-                "masking only (general mask arrays don't shard)")
-        if segment_ids is None:
-            sharded = jax.shard_map(
-                functools.partial(fn, axis_name=axis_name, causal=causal,
-                                  softmax_scale=softmax_scale),
-                mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-                check_vma=False)
-            return sharded(q, k, v)
-
-        def seg_fn(q_, k_, v_, seg):
-            return fn(q_, k_, v_, axis_name=axis_name, causal=causal,
-                      softmax_scale=softmax_scale,
-                      q_segment_ids=seg, kv_segment_ids=seg)
-
+            in_specs.append(mask_spec)
+            extras.append(mask)
         sharded = jax.shard_map(
-            seg_fn, mesh=mesh, in_specs=(spec, spec, spec, seg_spec),
-            out_specs=spec, check_vma=False)
-        return sharded(q, k, v, segment_ids)
+            inner_fn, mesh=mesh, in_specs=tuple(in_specs), out_specs=spec,
+            check_vma=False)
+        return sharded(q, k, v, *extras)
 
     return attention_fn

@@ -66,17 +66,24 @@ def block_fn_from_config(cfg: tfm.TransformerConfig) -> Callable:
     return block_fn
 
 
-def _check_supported(cfg: tfm.TransformerConfig, batch: PyTree | None = None):
+def _check_supported(cfg: tfm.TransformerConfig):
     if not cfg.scan_layers:
         raise ValueError(
             "pipeline parallelism consumes the nn.scan-stacked layer layout; "
             "set scan_layers=True (the default)")
-    if batch is not None and "segment_ids" in batch \
-            and cfg.position == "learned":
-        raise NotImplementedError(
-            "packed sequences on the pipeline path support rope/none "
-            "positions only (learned positions would need packed indices at "
-            "the embedding, outside the schedule)")
+
+
+def _position_indices(cfg: tfm.TransformerConfig, inputs: jax.Array,
+                      segment_ids: jax.Array | None) -> jax.Array | None:
+    """Learned-position embedding indices, or None for rope/none models:
+    absolute 0..S-1 normally, per-document restarts for packed rows — the
+    same contract the non-pipelined core applies at embed time
+    (models/transformer.py Transformer.__call__)."""
+    if cfg.position != "learned":
+        return None
+    if segment_ids is not None:
+        return tfm.packed_positions(segment_ids)
+    return jnp.broadcast_to(jnp.arange(inputs.shape[1]), inputs.shape)
 
 
 def _prepare_lm_batch(batch: PyTree):
@@ -136,20 +143,19 @@ def make_hidden_fn(model, mesh: Mesh, *, num_microbatches: int,
     norm = tfm.make_norm(cfg, None)
 
     def fn(params, tokens, segment_ids=None, rng=None):
-        _check_supported(cfg, None if segment_ids is None
-                         else {"segment_ids": segment_ids})
         params = nn.meta.unbox(params)
         tp = params["transformer"]
         emb = tp["tok_embed"]["embedding"]
         x = jnp.take(emb, tokens, axis=0).astype(cfg.dtype)
-        if cfg.position == "learned":
-            pos = tp["pos_embed"]["embedding"]
-            x = x + jnp.take(pos, jnp.arange(tokens.shape[1]), axis=0
-                             ).astype(cfg.dtype)
+        pos_idx = _position_indices(cfg, tokens, segment_ids)
+        if pos_idx is not None:
+            x = x + jnp.take(tp["pos_embed"]["embedding"], pos_idx,
+                             axis=0).astype(cfg.dtype)
         args = [tp["blocks"], x]
         if segment_ids is not None:
             args.append({"segment_ids": segment_ids,
-                         "positions": tfm.packed_positions(segment_ids)})
+                         "positions": pos_idx if pos_idx is not None
+                         else tfm.packed_positions(segment_ids)})
         if rng is not None:
             args.append(rng)
         x = pipe_for(segment_ids is not None, rng is not None)(*args)
@@ -222,10 +228,6 @@ class PipelineTrainer:
         if schedule not in ("gpipe", "1f1b", "interleaved"):
             raise ValueError(f"schedule must be 'gpipe', '1f1b' or "
                              f"'interleaved', got {schedule!r}")
-        if schedule in ("1f1b", "interleaved") and cfg.position == "learned":
-            raise NotImplementedError(
-                f"{schedule} owns the embedding backward and supports "
-                "rope/none positions only")
         stages = mesh.shape[axis_name]
         if cfg.n_layers % stages:
             raise ValueError(
@@ -328,7 +330,6 @@ class PipelineTrainer:
         import flax.linen as nn
         from k8s_distributed_deeplearning_tpu.models.llama import unembedding
 
-        _check_supported(self.model.cfg, batch)
         if self.schedule == "interleaved":
             # Eval path runs the contiguous-stage forward: back to the
             # natural layer stack (free reshape; resharding is eval-only).
@@ -390,9 +391,13 @@ class PipelineTrainer:
                     {"accuracy": correct / tm})
         return loss_mb_fn
 
-    def _assemble_grads(self, inputs, dx, g_blocks, g_head, emb):
+    def _assemble_grads(self, inputs, dx, g_blocks, g_head, emb,
+                        pos_idx=None, pos_tab=None):
         """Schedule outputs -> full params-tree gradients (embedding
-        scatter + tied-weight fold). Shared by both schedule engines."""
+        scatter + tied-weight fold + learned-position scatter). Shared by
+        both schedule engines. ``dx`` is d(loss)/d(embedded input); since
+        x = tok_embed[inputs] (+ pos_embed[pos_idx]), the same cotangent
+        scatters into both tables."""
         cfg = self.model.cfg
         g_emb = jnp.zeros(emb.shape, emb.dtype).at[inputs].add(
             dx.astype(emb.dtype))
@@ -401,6 +406,10 @@ class PipelineTrainer:
         grads = {"transformer": {"tok_embed": {"embedding": g_emb},
                                  "blocks": g_blocks,
                                  "final_norm": g_head["final_norm"]}}
+        if pos_idx is not None:
+            g_pos = jnp.zeros(pos_tab.shape, pos_tab.dtype).at[pos_idx].add(
+                dx.astype(pos_tab.dtype))
+            grads["transformer"]["pos_embed"] = {"embedding": g_pos}
         if not cfg.tie_embeddings:
             grads["head"] = {"lm_head": {"kernel": g_head["unembed"]}}
         return grads
@@ -418,7 +427,6 @@ class PipelineTrainer:
 
         interleaved = self.schedule == "interleaved"
         cfg = self.model.cfg
-        _check_supported(cfg, batch)
         if not cfg.dropout_rate:
             rng = None
         params = nn.meta.unbox(params)
@@ -471,16 +479,22 @@ class PipelineTrainer:
 
         emb = tp["tok_embed"]["embedding"]
         x = jnp.take(emb, inputs, axis=0).astype(cfg.dtype)
+        pos_idx = _position_indices(cfg, inputs, seg_in)
+        pos_tab = tp["pos_embed"]["embedding"] if pos_idx is not None else None
+        if pos_idx is not None:
+            x = x + jnp.take(pos_tab, pos_idx, axis=0).astype(cfg.dtype)
         aux_tree = {"targets": targets, "mask": mask}
         args = [tp["blocks"], head_side, x, aux_tree, total_mask]
         if packed:
             args.append({"segment_ids": seg_in,
-                         "positions": tfm.packed_positions(seg_in)})
+                         "positions": pos_idx if pos_idx is not None
+                         else tfm.packed_positions(seg_in)})
         if stochastic:
             args.append(rng)
         loss, metrics, g_blocks, g_head, dx = sharded(*args)
 
-        grads = self._assemble_grads(inputs, dx, g_blocks, g_head, emb)
+        grads = self._assemble_grads(inputs, dx, g_blocks, g_head, emb,
+                                     pos_idx, pos_tab)
         return loss, {"accuracy": metrics["accuracy"],
                       "perplexity": jnp.exp(loss)}, grads
 
