@@ -318,7 +318,13 @@ def main(argv: list[str] | None = None) -> dict:
                         max_to_keep=conf.max_checkpoints_to_keep,
                         keep_best_metric="loss" if conf.keep_best else None,
                         best_mode="min",
-                        async_save=conf.async_checkpoint)
+                        async_save=conf.async_checkpoint,
+                        # Canonical on-disk layout: checkpoints written
+                        # under one pipeline schedule restore under any
+                        # other (the interleaved trainer's chunk-arranged
+                        # blocks reshape to/from the natural [L, ...] form).
+                        portable_transforms=getattr(
+                            trainer, "portable_transforms", lambda: None)())
     preemption = PreemptionHandler.install()
     profiler = (StepProfiler(args.profile_dir, start_step=10, num_steps=5,
                              enabled=distributed.is_primary())

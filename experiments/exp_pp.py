@@ -25,10 +25,12 @@ toks = jnp.asarray(np.random.default_rng(0).integers(
     0, cfg.vocab_size, size=(B, S + 1), dtype=np.int32))
 batch = {"tokens": toks}
 
-for sched in ("gpipe", "1f1b"):
+for sched in ("gpipe", "1f1b", "interleaved"):
     for m in (4, 16):
+        kw = {"num_virtual": 2} if sched == "interleaved" else {}
         tr = pipeline_lm.PipelineTrainer(model, optax.adam(1e-3), mesh,
-                                         num_microbatches=m, schedule=sched)
+                                         num_microbatches=m, schedule=sched,
+                                         **kw)
         state = tr.init(lambda r: model.init(
             r, jnp.zeros((1, 8), jnp.int32))["params"], jax.random.key(0))
         step = tr.make_step(donate=False)
@@ -42,9 +44,14 @@ for sched in ("gpipe", "1f1b"):
             state, loss, _ = step(state, b, jax.random.key(i))
         float(loss)
         ms = (time.perf_counter() - t0) / 5 * 1e3
-        p = 4
-        bubble = ((p - 1) / (m + p - 1) if sched == "gpipe"
-                  else (2 * p - 1) / (m + 2 * p - 1))
+        p, v = 4, 2
+        # Wall-clock-model bubbles: invalid slots are cond-SKIPPED, so a
+        # warmup tick costs one fwd and a drain tick one bwd; in
+        # fwd-equivalents (b = 2f) the totals are 3f(M+P-1) for 1f1b
+        # (= GPipe's schedule length) and 3f(MV+P-1)/V for interleaved.
+        bubble = {"gpipe": (p - 1) / (m + p - 1),
+                  "1f1b": (p - 1) / (m + p - 1),
+                  "interleaved": (p - 1) / (m * v + p - 1)}[sched]
         print(json.dumps({
             "schedule": sched, "microbatches": m,
             "step_ms": round(ms, 1),

@@ -19,8 +19,14 @@ HBM. Design is the shard_map-native schedule:
   makes the transpose sum to exactly the right cotangent (ḡ/P per stage,
   psum → ḡ).
 
-Every stage computes every tick (SPMD) — bubble ticks process garbage that
-never reaches an output, the standard trade for compiler-friendly uniformity.
+In the forward-only GPipe schedule every stage computes every tick (SPMD) —
+bubble ticks process garbage that never reaches an output, the standard
+trade for compiler-friendly uniformity. The 1F1B-family loss+grad engines
+instead SKIP invalid slots with ``lax.cond`` (pure compute inside, every
+collective outside, so per-device predicates are legal): bubble ticks cost
+one slot instead of a full fwd+bwd pair, which is what lets the uniform
+tick grid match (1f1b) or beat (interleaved) GPipe's wall-clock at O(P)
+memory.
 """
 from __future__ import annotations
 
@@ -148,14 +154,16 @@ def pipeline_value_and_grad_1f1b(
 
     Unlike the GPipe path (forward schedule + autodiff transpose, which
     stores one activation per microbatch per stage — O(M) — before any
-    backward runs), this schedule interleaves: each tick runs one
+    backward runs), this schedule interleaves: each tick carries one
     microbatch-forward AND one microbatch-backward slot on every stage, so
     a microbatch's stored stage input is freed 2(P - stage) - 1 ticks after
     it is saved and the activation ring buffer holds min(M, 2P) entries —
-    O(P), independent of microbatch count. The uniform-tick SPMD form pays
-    for this with a longer drain: (2P-1)/(M+2P-1) bubble vs GPipe's
-    (P-1)/(M+P-1); 1F1B is the memory schedule, GPipe the latency schedule
-    (both measured in BENCHMARKS.md).
+    O(P), independent of microbatch count. Invalid slots are skipped via
+    ``lax.cond`` (not computed-then-masked), so although the uniform-tick
+    SPMD form runs M + 2P - 1 ticks, warmup ticks cost one forward and
+    drain ticks one backward — total wall-clock work 3f·(M + P - 1) in
+    forward-equivalents, the SAME as GPipe's schedule length, at O(P)
+    instead of O(M) memory (measured in BENCHMARKS.md).
 
     - ``block_fn`` as in :func:`pipeline_apply` (2- or 4-arg form).
     - ``loss_mb_fn(head_params, y_mb, aux_mb) -> (scalar, aux_scalars)``:
@@ -214,6 +222,15 @@ def pipeline_value_and_grad_1f1b(
         (fwd_cur, pending_dy, bwd_cur, act_buf, g_blocks, g_head,
          loss_acc, aux_acc, dx_out) = carry
 
+        # Invalid slots are SKIPPED via lax.cond, not computed-then-masked:
+        # a warmup tick (no valid backward anywhere) then costs one
+        # forward, a drain tick one backward — which is what makes this
+        # uniform-tick schedule's wall-clock match the classic non-uniform
+        # 1F1B accounting (bubble (P-1)/(M+P-1), GPipe's latency, at O(P)
+        # memory — measured in BENCHMARKS.md). The predicates are
+        # per-device (stage enters them): legal because the cond bodies
+        # contain pure compute only — every collective stays OUTSIDE.
+
         # ---- forward slot: microbatch i = t - stage -------------------
         i = t - stage
         i_c = jnp.clip(i, 0, m - 1)
@@ -222,29 +239,46 @@ def pipeline_value_and_grad_1f1b(
         x_in = jnp.where(stage == 0, inject.astype(out0.dtype), fwd_cur)
         ex_i = slice_tree(micro_extras, i_c)
         r_i = None if rng is None else jax.random.fold_in(rng, i_c)
-        y = stage_fwd(stacked_params, x_in, ex_i, r_i)
-        # Save the stage INPUT for the backward's recompute-vjp; ring slot
-        # i % k_slots is free again by the time i + k_slots arrives.
-        upd = lax.dynamic_update_index_in_dim(act_buf, x_in,
-                                              i_c % k_slots, 0)
-        act_buf = jnp.where(fwd_valid, upd, act_buf)
+
+        def do_fwd(_):
+            y = stage_fwd(stacked_params, x_in, ex_i, r_i)
+            # Save the stage INPUT for the backward's recompute-vjp; ring
+            # slot i % k_slots is free again by the time i + k_slots
+            # arrives.
+            return y, lax.dynamic_update_index_in_dim(act_buf, x_in,
+                                                      i_c % k_slots, 0)
+
+        def skip_fwd(_):
+            return jnp.zeros(out0.shape, out0.dtype), act_buf
+
+        y, act_buf = lax.cond(fwd_valid, do_fwd, skip_fwd, None)
         nxt_fwd = lax.ppermute(y, axis_name, fwd_shift)
 
         # ---- last stage: loss + cotangent for the microbatch whose
-        # forward just finished (consumed by next tick's backward slot)
-        aux_i = slice_tree(micro_aux, i_c)
-        loss_i, head_vjp, metrics_i = jax.vjp(
-            lambda hp, y_: loss_mb_fn(hp, y_, aux_i), head_params, y,
-            has_aux=True)
-        dhead_i, dy_i = head_vjp(jnp.ones((), loss_i.dtype))
-        dy_i = dy_i.astype(out0.dtype)   # cotangents ride in activation dtype
+        # forward just finished (consumed by next tick's backward slot).
+        # Under cond: only the last stage pays the head matmul (the round-3
+        # engine computed it on every stage every tick).
         last_valid = fwd_valid & (stage == p - 1)
-        loss_acc = loss_acc + jnp.where(last_valid, loss_i, 0.0)
-        aux_acc = jax.tree.map(
-            lambda a, v: a + jnp.where(last_valid, v, 0.0), aux_acc,
-            metrics_i)
-        g_head = jax.tree.map(
-            lambda g, d: g + jnp.where(last_valid, d, 0), g_head, dhead_i)
+        aux_i = slice_tree(micro_aux, i_c)
+
+        # Accumulators thread THROUGH the cond (the skip branch returns
+        # them untouched) so a skipped slot does no dense tree-add either.
+        def do_head(_):
+            loss_i, head_vjp, metrics_i = jax.vjp(
+                lambda hp, y_: loss_mb_fn(hp, y_, aux_i), head_params, y,
+                has_aux=True)
+            dhead_i, dy_i = head_vjp(jnp.ones((), loss_i.dtype))
+            return (loss_acc + loss_i,
+                    jax.tree.map(jnp.add, aux_acc, metrics_i),
+                    jax.tree.map(jnp.add, g_head, dhead_i),
+                    dy_i.astype(out0.dtype))
+
+        def skip_head(_):
+            return (loss_acc, aux_acc, g_head,
+                    jnp.zeros(out0.shape, out0.dtype))
+
+        loss_acc, aux_acc, g_head, dy_i = lax.cond(
+            last_valid, do_head, skip_head, None)
 
         # ---- backward slot: microbatch j = t - 2p + 1 + stage ---------
         j = t - 2 * p + 1 + stage
@@ -255,12 +289,18 @@ def pipeline_value_and_grad_1f1b(
                                            keepdims=False)
         ex_j = slice_tree(micro_extras, j_c)
         r_j = None if rng is None else jax.random.fold_in(rng, j_c)
-        _, stage_vjp = jax.vjp(
-            lambda pr, xi: stage_fwd(pr, xi, ex_j, r_j),
-            stacked_params, x_saved)
-        dparams_j, dx_j = stage_vjp(dy.astype(out0.dtype))
-        g_blocks = jax.tree.map(
-            lambda g, d: g + jnp.where(bwd_valid, d, 0), g_blocks, dparams_j)
+
+        def do_bwd(_):
+            _, stage_vjp = jax.vjp(
+                lambda pr, xi: stage_fwd(pr, xi, ex_j, r_j),
+                stacked_params, x_saved)
+            dparams_j, dx_j = stage_vjp(dy.astype(out0.dtype))
+            return jax.tree.map(jnp.add, g_blocks, dparams_j), dx_j
+
+        def skip_bwd(_):
+            return g_blocks, jnp.zeros(out0.shape, out0.dtype)
+
+        g_blocks, dx_j = lax.cond(bwd_valid, do_bwd, skip_bwd, None)
         nxt_bwd = lax.ppermute(dx_j, axis_name, bwd_shift)
         # Stage 0's dx is the embedding cotangent — record it.
         upd_dx = lax.dynamic_update_index_in_dim(dx_out, dx_j, j_c, 0)
@@ -333,15 +373,16 @@ def pipeline_value_and_grad_interleaved(
       not on every stage every tick (the r3 1F1B paid the head matmul
       unconditionally).
 
-    Versus the plain uniform 1F1B: ticks are CHUNK-sized (1/V of a stage),
-    so the drain shrinks — total ticks M·V + P·V + P - 1 of work 1/V each,
-    i.e. bubble fraction (PV + P - 1)/(MV + PV + P - 1) vs (2P-1)/(M+2P-1)
-    (at P=4, M=16, V=2: 11/43 = 0.256 vs 0.304), at the same O(P) activation
-    memory (ring of min(MV, 2PV) chunk-inputs = the 1F1B bound). GPipe's
-    (P-1)/(M+P-1) latency bubble remains lower at O(M) memory; a fully
-    Megatron-style non-uniform warmup (double-rate forward ticks) would
-    close that too but breaks the uniform-tick chunk-wrap timing —
-    measured trade recorded in BENCHMARKS.md.
+    Versus the plain uniform 1F1B: ticks are CHUNK-sized (1/V of a stage)
+    and invalid slots are cond-SKIPPED, so the warmup/drain cost shrinks
+    by V — wall-clock work 3f·(MV + P - 1) in chunk-forward-equivalents,
+    i.e. bubble (P-1)/(MV + P - 1), BELOW GPipe's (P-1)/(M+P-1) for any
+    V >= 2 (at P=4, M=16, V=2: 0.086 vs 0.158), at the same O(P)
+    activation memory (ring of min(MV, 2PV) chunk-inputs = the 1F1B
+    bound). This is the Megatron interleaved result without non-uniform
+    warmup: the cond makes a skipped slot nearly free, so the uniform
+    tick grid no longer costs latency (measured in BENCHMARKS.md —
+    interleaved is both the fastest and the smallest schedule).
 
     Same contract as :func:`pipeline_value_and_grad_1f1b` otherwise;
     returns ``(loss, aux_scalars, grads_chunks [V, L_chunk, ...],
@@ -396,24 +437,17 @@ def pipeline_value_and_grad_interleaved(
                            jnp.zeros(out0.shape, out0.dtype),
                            slice_tree(micro_aux, i0))[1])
 
-    def head_slot(hp, y, aux_i):
-        loss_i, head_vjp, metrics_i = jax.vjp(
-            lambda hp_, y_: loss_mb_fn(hp_, y_, aux_i), hp, y,
-            has_aux=True)
-        dhead_i, dy_i = head_vjp(jnp.ones((), loss_i.dtype))
-        return (loss_i, metrics_i, dhead_i, dy_i.astype(out0.dtype))
-
-    def head_zeros(hp, y, aux_i):
-        return (jnp.zeros((), jnp.float32),
-                jax.tree.map(lambda a: jnp.zeros(a.shape, a.dtype), aux0),
-                zeros_like_tree(hp),
-                jnp.zeros(out0.shape, out0.dtype))
-
     def tick(carry, t):
         (fwd_cur, pending_dy, bwd_cur, act_buf, g_chunks, g_head,
          loss_acc, aux_acc, dx_out) = carry
 
         # ---- forward slot: slot-line s = t - stage --------------------
+        # Invalid slots are SKIPPED via lax.cond (pure compute inside, all
+        # collectives outside — per-device predicates are then legal), so
+        # warmup ticks cost one chunk-forward and drain ticks one
+        # chunk-backward instead of both: the wall-clock bubble becomes
+        # (P-1)/(MV+P-1) — BELOW GPipe's (P-1)/(M+P-1) for V >= 2 — at
+        # the same O(P) ring memory (measured in BENCHMARKS.md).
         s = t - stage
         s_c = jnp.clip(s, 0, mv - 1)
         fwd_valid = (s >= 0) & (s < mv)
@@ -424,20 +458,41 @@ def pipeline_value_and_grad_interleaved(
                          inject.astype(out0.dtype), fwd_cur)
         ex_i = slice_tree(micro_extras, i)
         r_i = None if rng is None else jax.random.fold_in(rng, i)
-        y = chunk_fwd(chunk_at(q), x_in, ex_i, r_i, q)
-        upd = lax.dynamic_update_index_in_dim(act_buf, x_in,
-                                              s_c % k_slots, 0)
-        act_buf = jnp.where(fwd_valid, upd, act_buf)
+
+        def do_fwd(_):
+            y = chunk_fwd(chunk_at(q), x_in, ex_i, r_i, q)
+            return y, lax.dynamic_update_index_in_dim(act_buf, x_in,
+                                                      s_c % k_slots, 0)
+
+        def skip_fwd(_):
+            return jnp.zeros(out0.shape, out0.dtype), act_buf
+
+        y, act_buf = lax.cond(fwd_valid, do_fwd, skip_fwd, None)
         nxt_fwd = lax.ppermute(y, axis_name, fwd_shift)
 
         # ---- head slot: only when the FINAL chunk just finished -------
+        # Accumulators thread THROUGH the cond (skip returns them
+        # untouched): a non-head tick does neither the head matmul nor a
+        # dense accumulator add.
         head_valid = fwd_valid & (stage == p - 1) & (q == v - 1)
         aux_i = slice_tree(micro_aux, i)
-        loss_i, metrics_i, dhead_i, dy_i = lax.cond(
-            head_valid, head_slot, head_zeros, head_params, y, aux_i)
-        loss_acc = loss_acc + loss_i            # zero when not head slot
-        aux_acc = jax.tree.map(jnp.add, aux_acc, metrics_i)
-        g_head = jax.tree.map(jnp.add, g_head, dhead_i)
+
+        def do_head(_):
+            loss_i, head_vjp, metrics_i = jax.vjp(
+                lambda hp_, y_: loss_mb_fn(hp_, y_, aux_i), head_params, y,
+                has_aux=True)
+            dhead_i, dy_i = head_vjp(jnp.ones((), loss_i.dtype))
+            return (loss_acc + loss_i,
+                    jax.tree.map(jnp.add, aux_acc, metrics_i),
+                    jax.tree.map(jnp.add, g_head, dhead_i),
+                    dy_i.astype(out0.dtype))
+
+        def skip_head(_):
+            return (loss_acc, aux_acc, g_head,
+                    jnp.zeros(out0.shape, out0.dtype))
+
+        loss_acc, aux_acc, g_head, dy_i = lax.cond(
+            head_valid, do_head, skip_head, None)
 
         # ---- backward slot: u = t - (p-1-stage) - p*v -----------------
         u = t - (p - 1 - stage) - pv
@@ -452,13 +507,19 @@ def pipeline_value_and_grad_interleaved(
                                            keepdims=False)
         ex_j = slice_tree(micro_extras, ib)
         r_j = None if rng is None else jax.random.fold_in(rng, ib)
-        _, chunk_vjp = jax.vjp(
-            lambda pr, xi: chunk_fwd(pr, xi, ex_j, r_j, bq),
-            chunk_at(bq), x_saved)
-        dparams_j, dx_j = chunk_vjp(dy.astype(out0.dtype))
-        g_chunks = jax.tree.map(
-            lambda g, d: g.at[bq].add(jnp.where(bwd_valid, d, 0)),
-            g_chunks, dparams_j)
+
+        def do_bwd(_):
+            _, chunk_vjp = jax.vjp(
+                lambda pr, xi: chunk_fwd(pr, xi, ex_j, r_j, bq),
+                chunk_at(bq), x_saved)
+            dparams_j, dx_j = chunk_vjp(dy.astype(out0.dtype))
+            return (jax.tree.map(lambda g, d: g.at[bq].add(d),
+                                 g_chunks, dparams_j), dx_j)
+
+        def skip_bwd(_):
+            return g_chunks, jnp.zeros(out0.shape, out0.dtype)
+
+        g_chunks, dx_j = lax.cond(bwd_valid, do_bwd, skip_bwd, None)
         nxt_bwd = lax.ppermute(dx_j, axis_name, bwd_shift)
         # Chunk 0 on device 0 produces the embedding cotangent.
         upd_dx = lax.dynamic_update_index_in_dim(dx_out, dx_j, ib, 0)

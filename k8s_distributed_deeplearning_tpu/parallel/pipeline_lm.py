@@ -195,16 +195,18 @@ class PipelineTrainer:
 
     - ``"gpipe"`` (default): forward schedule + autodiff transpose. Stores
       one activation per microbatch per stage before backward starts
-      (O(M) memory); bubble (P-1)/(M+P-1) — the latency schedule.
+      (O(M) memory); bubble (P-1)/(M+P-1).
     - ``"1f1b"``: one-forward-one-backward
       (:func:`parallel.pipeline.pipeline_value_and_grad_1f1b`). Activation
-      ring buffer bounded at min(M, 2P) entries (O(P) — the memory
-      schedule, for M >> P); uniform-tick bubble (2P-1)/(M+2P-1).
+      ring buffer bounded at min(M, 2P) entries (O(P) memory); invalid
+      slots are cond-skipped, so the wall-clock bubble matches GPipe's
+      (P-1)/(M+P-1).
     - ``"interleaved"``: virtual-stage 1F1B
       (:func:`parallel.pipeline.pipeline_value_and_grad_interleaved`):
       each device holds ``num_virtual`` non-contiguous layer chunks, the
-      head/loss computes only on head slots, bubble
-      (PV+P-1)/(MV+PV+P-1) at the same O(P) memory. Needs
+      head/loss computes only on head slots, bubble (P-1)/(MV+P-1) —
+      below GPipe for V >= 2 — at the same O(P) memory: the fastest AND
+      smallest schedule (BENCHMARKS.md). Needs
       ``num_microbatches % stages == 0`` and
       ``n_layers % (stages * num_virtual) == 0``. The TrainState stores
       block weights chunk-arranged as ``[V, P, L/(P·V), ...]`` (a free
@@ -263,12 +265,24 @@ class PipelineTrainer:
             axis_name=axis_name, data_axes=data_axes)
 
     # -- placement ---------------------------------------------------------
-    def _spec_for_path(self, path) -> P:
+    def _spec_for_path(self, path, leaf=None) -> P:
+        """Sharding spec for one state leaf. Block leaves shard over the
+        pipeline axis ONLY when their shape actually carries the layer
+        stack — optimizer states can hold degenerate stand-in leaves under
+        the blocks path (adafactor's (1,)-shaped placeholders for
+        non-factored params), which must replicate instead of erroring."""
         keys = [getattr(k, "key", getattr(k, "name", None)) for k in path]
-        if "blocks" in keys:
-            if self.schedule == "interleaved":
-                # [V, P, L/(PV), ...]: shard the device dim.
+        if "blocks" not in keys:
+            return P()
+        stages = self.mesh.shape[self.axis_name]
+        ndim = getattr(leaf, "ndim", None)
+        if self.schedule == "interleaved":
+            # [V, P, L/(PV), ...]: shard the device dim.
+            if ndim is None or (ndim >= 2 and leaf.shape[1] == stages):
                 return P(None, self.axis_name)
+            return P()
+        if ndim is None or (ndim >= 1 and leaf.shape[0] >= stages
+                            and leaf.shape[0] % stages == 0):
             return P(self.axis_name)     # stacked layer axis -> stage shard
         return P()
 
@@ -292,9 +306,76 @@ class PipelineTrainer:
         return {**params, "transformer": {**params["transformer"],
                                           "blocks": blocks}}
 
+    def portable_transforms(self):
+        """``(to_portable, from_portable)`` for ``Checkpointer``: the
+        on-disk layout is canonically the natural ``[L, ...]`` stacked-layer
+        blocks, so checkpoints interchange across schedules AND with the
+        non-pipelined trainers (write under 1f1b, resume under interleaved,
+        or vice versa — the elastic-resize/cross-topology contract). The
+        gpipe/1f1b state already IS natural: returns None for them; the
+        interleaved trainer's chunk-arranged ``[V, P, L/PV, ...]`` blocks
+        reshape both ways (free), covering the optimizer moments too (they
+        mirror the params tree, including adafactor's reduced-dim factored
+        moments — the leading chunk dims survive the reduction, and its
+        (1,)-shaped placeholder leaves are excluded by the divisibility
+        guard). The natural on-disk contract holds from the round this
+        shipped; chunk-arranged checkpoints written by the brief pre-
+        portable interleaved trainer are not restorable (re-save from a
+        live run)."""
+        if self.schedule != "interleaved":
+            return None
+        v, p = self.num_virtual, self.mesh.shape[self.axis_name]
+
+        def in_blocks(path):
+            return any(getattr(k, "key", getattr(k, "name", None)) == "blocks"
+                       for k in path)
+
+        def to_portable(tree):
+            def one(path, leaf):
+                if in_blocks(path) and getattr(leaf, "ndim", 0) >= 3:
+                    shape = (leaf.shape[0] * leaf.shape[1] * leaf.shape[2],
+                             *leaf.shape[3:])
+                    if isinstance(leaf, jax.ShapeDtypeStruct):
+                        # The chunk-dim sharding (P sharded on dim 1) has
+                        # no equivalent on the merged natural dim (q-major
+                        # element order), so an abstract template can't
+                        # carry a faithful target sharding — demand the
+                        # concrete state (what loop.fit and the trainers
+                        # pass) instead of restoring unsharded and
+                        # spiking HBM on large models.
+                        raise NotImplementedError(
+                            "interleaved-schedule portable restore needs "
+                            "the concrete TrainState as the template, not "
+                            "ShapeDtypeStructs (block leaf at "
+                            f"{jax.tree_util.keystr(path)})")
+                    return leaf.reshape(shape)
+                return leaf
+            return jax.tree_util.tree_map_with_path(one, tree)
+
+        def from_portable(tree):
+            def one(path, leaf):
+                # Mirror to_portable's ndim>=3 selection: only leaves whose
+                # natural form is a [L, ...] flatten of [V, P, nl, ...]
+                # reshape back. Divisibility excludes optimizer
+                # PLACEHOLDER leaves (e.g. adafactor's (1,)-shaped v_row
+                # stand-ins for non-factored params, which also live under
+                # a "blocks" path).
+                if (in_blocks(path) and getattr(leaf, "ndim", 0) >= 1
+                        and leaf.shape[0] >= v * p
+                        and leaf.shape[0] % (v * p) == 0):
+                    nl = leaf.shape[0] // (v * p)
+                    return leaf.reshape(v, p, nl, *leaf.shape[1:])
+                return leaf
+            out = jax.tree_util.tree_map_with_path(one, tree)
+            if getattr(self, "_state_sh", None) is not None:
+                out = jax.device_put(out, self._state_sh)
+            return out
+
+        return to_portable, from_portable
+
     def state_shardings(self, abstract_state: PyTree) -> PyTree:
         def one(path, leaf):
-            spec = (self._spec_for_path(path)
+            spec = (self._spec_for_path(path, leaf)
                     if getattr(leaf, "ndim", 0) else P())
             return NamedSharding(self.mesh, spec)
         return jax.tree_util.tree_map_with_path(one, abstract_state)

@@ -41,10 +41,22 @@ class Checkpointer:
 
     def __init__(self, directory: str, max_to_keep: int = 3,
                  keep_best_metric: str | None = None,
-                 best_mode: str = "max", async_save: bool = False):
+                 best_mode: str = "max", async_save: bool = False,
+                 portable_transforms=None):
+        """``portable_transforms`` is an optional ``(to_portable,
+        from_portable)`` pair canonicalizing the ON-DISK layout: ``save``
+        writes ``to_portable(state)`` and the restore paths return
+        ``from_portable(restored)``. Trainers whose in-memory state uses a
+        schedule-specific layout (the interleaved pipeline's chunk-arranged
+        ``[V, P, L/PV, ...]`` blocks — ``PipelineTrainer
+        .portable_transforms``) pass their reshapes here so checkpoints
+        stay interchangeable across schedules and with the non-pipelined
+        trainers (cross-topology restore, the elastic-resize contract)."""
         self.directory = os.path.abspath(directory)
         self.keep_best_metric = keep_best_metric
         self.async_save = async_save
+        self._to_portable, self._from_portable = portable_transforms or (
+            None, None)
         if keep_best_metric is not None:
             # orbax doesn't re-export preservation policies at top level;
             # `orbax.checkpoint.checkpoint_managers` is the most public
@@ -84,6 +96,8 @@ class Checkpointer:
         background thread — the train loop keeps stepping while the previous
         checkpoint writes (Orbax itself serializes overlapping saves).
         Synchronous mode (default) blocks until the write is durable."""
+        if self._to_portable is not None:
+            state = self._to_portable(state)
         saved = self._mgr.save(step, args=ocp.args.StandardSave(state),
                                force=force, metrics=metrics)
         if not self.async_save:
@@ -124,12 +138,18 @@ class Checkpointer:
                       abstract_state: PyTree) -> tuple[PyTree, int] | None:
         if step is None:
             return None
+        if self._to_portable is not None:
+            # The on-disk layout is the portable one: build the restore
+            # template in that layout, then map back to the trainer's.
+            abstract_state = self._to_portable(abstract_state)
         ref = jax.tree.map(
             lambda x: x if isinstance(x, jax.ShapeDtypeStruct)
             else jax.ShapeDtypeStruct(jax.numpy.shape(x), x.dtype,
                                       sharding=getattr(x, "sharding", None)),
             abstract_state)
         state = self._mgr.restore(step, args=ocp.args.StandardRestore(ref))
+        if self._from_portable is not None:
+            state = self._from_portable(state)
         return state, step
 
     def restore_params(self, key: str = "params",
