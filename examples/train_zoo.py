@@ -86,6 +86,9 @@ def main(argv: list[str] | None = None) -> dict:
     ap.add_argument("--image-size", type=int, default=None)
     ap.add_argument("--seq-len", type=int, default=128)
     ap.add_argument("--optimizer", choices=optim.OPTIMIZERS, default="adamw")
+    ap.add_argument("--moment-dtype", choices=["float32", "bfloat16"],
+                    default=None,
+                    help="adam/adamw/lion first-moment storage dtype")
     ap.add_argument("--schedule", choices=optim.SCHEDULES, default="constant")
     ap.add_argument("--warmup-steps", type=int, default=0)
     ap.set_defaults(grad_clip=1.0)       # transformer-training default
@@ -107,7 +110,8 @@ def main(argv: list[str] | None = None) -> dict:
     lr = optim.make_schedule(args.schedule, conf.lr, num_steps,
                              args.warmup_steps)
     optimizer = optim.make_optimizer(args.optimizer, lr,
-                                     grad_clip=args.grad_clip or None)
+                                     grad_clip=args.grad_clip or None,
+                                     moment_dtype=args.moment_dtype)
 
     # batch_size is PER-REPLICA (TrainConfig contract): the batch only shards
     # over the data(+fsdp) axes, so scale by those — not by all local devices,

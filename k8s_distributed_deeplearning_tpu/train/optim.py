@@ -39,15 +39,28 @@ def make_schedule(name: str, lr: float, total_steps: int,
 
 def make_optimizer(name: str, lr, *, weight_decay: float = 0.1,
                    grad_clip: float | None = 1.0,
-                   momentum: float = 0.9) -> optax.GradientTransformation:
+                   momentum: float = 0.9,
+                   moment_dtype: str | None = None
+                   ) -> optax.GradientTransformation:
     """Optimizer with optional global-norm clipping (standard LM hygiene the
-    reference lacks). *lr* may be a float or a schedule."""
+    reference lacks). *lr* may be a float or a schedule.
+
+    ``moment_dtype="bfloat16"`` stores the FIRST moment in bf16 —
+    adam/adamw's mu (optax ``mu_dtype``), lion's single moment, sgd's
+    momentum trace (``accumulator_dtype``): halves that state's HBM
+    footprint and, more importantly on TPU, its read+write traffic in the
+    update step — the standard low-precision-optimizer-state trade (the
+    adam second moment stays f32; its rsqrt is precision-sensitive).
+    Adafactor ignores it (factored moments are already the memory lever).
+    Measured: +12.5% on the 16-expert MoE bench (BENCHMARKS.md)."""
+    mu_dtype = moment_dtype or None
     if name == "adam":
-        tx = optax.adam(lr)
+        tx = optax.adam(lr, mu_dtype=mu_dtype)
     elif name == "adamw":
-        tx = optax.adamw(lr, weight_decay=weight_decay)
+        tx = optax.adamw(lr, weight_decay=weight_decay, mu_dtype=mu_dtype)
     elif name == "sgd":
-        tx = optax.sgd(lr, momentum=momentum, nesterov=True)
+        tx = optax.sgd(lr, momentum=momentum, nesterov=True,
+                       accumulator_dtype=mu_dtype)
     elif name == "adafactor":
         # The TPU-classic memory-efficient choice: factored second moments
         # store O(rows+cols) per matrix instead of O(rows*cols) — for the 8B
@@ -60,7 +73,7 @@ def make_optimizer(name: str, lr, *, weight_decay: float = 0.1,
         # rate by the caller.
         tx = optax.adafactor(lr)
     elif name == "lion":
-        tx = optax.lion(lr, weight_decay=weight_decay)
+        tx = optax.lion(lr, weight_decay=weight_decay, mu_dtype=mu_dtype)
     else:
         raise ValueError(f"optimizer {name!r} not in {OPTIMIZERS}")
     if grad_clip:

@@ -39,6 +39,29 @@ def test_optimizer_factory_variants():
         optim.make_optimizer("nope", 1e-2)
 
 
+def test_moment_dtype_bf16_halves_mu_storage():
+    """moment_dtype='bfloat16' stores adam(w)/lion's first moment in bf16
+    (the low-precision optimizer-state traffic lever) while updates stay
+    finite and params stay f32; optimizers without a dense mu ignore it."""
+    import jax
+    import jax.numpy as jnp
+    params = {"w": jnp.zeros((4, 4))}
+    grads = {"w": jnp.ones((4, 4))}
+    for name in ("adam", "adamw", "lion", "sgd"):
+        tx = optim.make_optimizer(name, 1e-2, moment_dtype="bfloat16")
+        st = tx.init(params)
+        mus = [l for l in jax.tree.leaves(st)
+               if getattr(l, "shape", None) == (4, 4)
+               and l.dtype == jnp.bfloat16]
+        assert mus, f"{name}: no bf16 moment leaf found"
+        upd, st2 = tx.update(grads, st, params)
+        assert np.isfinite(np.asarray(upd["w"])).all()
+        assert upd["w"].dtype == params["w"].dtype
+    for name in ("adafactor",):   # factored moments: flag is a no-op
+        tx = optim.make_optimizer(name, 1e-2, moment_dtype="bfloat16")
+        tx.update(grads, tx.init(params), params)
+
+
 @pytest.mark.slow
 @pytest.mark.parametrize("model,extra", [
     ("resnet18", []),
