@@ -300,9 +300,15 @@ def measure_moe(steps: int = 12, warmup: int = 3) -> dict:
     n_chips = jax.device_count()
     peak = mesh_lib.peak_flops_per_device("bfloat16")
     out: dict = {}
-    for label, n_exp, routing in (("moe_8e_top2", 8, "topk"),
-                                  ("moe_16e_top2", 16, "topk"),
-                                  ("moe_8e_ec", 8, "expert_choice")):
+    # The bf16-first-moment row measures the documented optimizer-traffic
+    # lever (train/optim.py moment_dtype) on the config it moves most: 16
+    # experts = 2x the expert params/optimizer state of the 8e rows
+    # (BENCHMARKS.md MoE notes; +12.5% at introduction).
+    for label, n_exp, routing, mu_dtype in (
+            ("moe_8e_top2", 8, "topk", None),
+            ("moe_16e_top2", 16, "topk", None),
+            ("moe_16e_top2_bf16m", 16, "topk", "bfloat16"),
+            ("moe_8e_ec", 8, "expert_choice", None)):
         cfg = _llama_small_cfg(1024)
         mcfg = moe_lib.MoEConfig(num_experts=n_exp, top_k=2,
                                  routing=routing)
@@ -311,7 +317,7 @@ def measure_moe(steps: int = 12, warmup: int = 3) -> dict:
         tr = sharding.ShardedTrainer(
             lambda p, b, r, _m=model, _mc=mcfg: moe_lib.loss_fn(
                 _m, _mc, p, b, r),
-            optax.adamw(1e-4), mesh)
+            optax.adamw(1e-4, mu_dtype=mu_dtype), mesh)
         state = tr.init(lambda r, _m=model: _m.init(
             r, jnp.zeros((1, 8), jnp.int32))["params"], jax.random.key(0))
         toks = jax.random.randint(jax.random.key(1), (B, S + 1), 0,

@@ -107,13 +107,12 @@ def main(argv: list[str] | None = None) -> dict:
                         help="pipeline microbatches (default: --pp)")
     parser.add_argument("--pp-schedule", choices=["gpipe", "1f1b", "interleaved"],
                         default="gpipe",
-                        help="pipeline schedule: gpipe = lowest bubble "
-                        "(latency schedule); 1f1b = activation memory "
-                        "bounded at min(M, 2P) microbatches (memory "
-                        "schedule — measured 6.5x less temp at M=16, P=4); "
-                        "interleaved = virtual-stage 1f1b, same memory "
-                        "with a (PV+P-1)/(MV+PV+P-1) bubble — strictly "
-                        "dominates 1f1b (BENCHMARKS.md)")
+                        help="pipeline schedule: gpipe = O(M) activation "
+                        "memory, bubble (P-1)/(M+P-1); 1f1b = same bubble "
+                        "at O(P) memory (invalid slots cond-skipped — "
+                        "measured 6x less temp at M=16, P=4); interleaved "
+                        "= virtual-stage 1f1b, bubble (P-1)/(MV+P-1) — "
+                        "fastest AND smallest (BENCHMARKS.md)")
     parser.add_argument("--pp-virtual", type=int, default=2,
                         help="virtual chunks per stage for "
                         "--pp-schedule interleaved")
@@ -154,9 +153,10 @@ def main(argv: list[str] | None = None) -> dict:
                         default="adamw")
     parser.add_argument("--moment-dtype", choices=["float32", "bfloat16"],
                         default=None,
-                        help="adam/adamw/lion first-moment storage dtype "
-                        "(bfloat16 halves mu's HBM footprint and update-"
-                        "step traffic; second moment stays f32)")
+                        help="first-moment storage dtype: adam/adamw mu, "
+                        "lion's moment, sgd's momentum trace (bfloat16 "
+                        "halves its HBM footprint and update-step "
+                        "traffic; adam's second moment stays f32)")
     parser.add_argument("--schedule", choices=optim.SCHEDULES,
                         default="constant")
     parser.add_argument("--warmup-steps", type=int, default=0)

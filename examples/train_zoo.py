@@ -88,7 +88,8 @@ def main(argv: list[str] | None = None) -> dict:
     ap.add_argument("--optimizer", choices=optim.OPTIMIZERS, default="adamw")
     ap.add_argument("--moment-dtype", choices=["float32", "bfloat16"],
                     default=None,
-                    help="adam/adamw/lion first-moment storage dtype")
+                    help="first-moment storage dtype (adam/adamw mu, "
+                    "lion's moment, sgd's momentum trace)")
     ap.add_argument("--schedule", choices=optim.SCHEDULES, default="constant")
     ap.add_argument("--warmup-steps", type=int, default=0)
     ap.set_defaults(grad_clip=1.0)       # transformer-training default

@@ -53,7 +53,7 @@ def make_optimizer(name: str, lr, *, weight_decay: float = 0.1,
     adam second moment stays f32; its rsqrt is precision-sensitive).
     Adafactor ignores it (factored moments are already the memory lever).
     Measured: +12.5% on the 16-expert MoE bench (BENCHMARKS.md)."""
-    mu_dtype = moment_dtype or None
+    mu_dtype = moment_dtype
     if name == "adam":
         tx = optax.adam(lr, mu_dtype=mu_dtype)
     elif name == "adamw":
