@@ -19,7 +19,7 @@ import jax.numpy as jnp
 import optax
 
 from k8s_distributed_deeplearning_tpu.models.transformer import (
-    LMHead, Transformer, TransformerConfig, packed_positions)
+    LMHead, Transformer, TransformerConfig, lm_batch_views)
 
 import flax.linen as nn
 
@@ -109,22 +109,15 @@ def loss_fn(model: LlamaLM, params, batch, rng=None, *,
     path is at least as accurate (its head matmul accumulates in f32 via
     ``preferred_element_type`` where ``LMHead`` emits bf16 then upcasts).
     """
-    tokens = batch["tokens"]
-    inputs, targets = tokens[:, :-1], tokens[:, 1:]
-    seg = batch.get("segment_ids")
+    # Shared shift/positions/mask contract (transformer.lm_batch_views):
+    # RoPE positions restart per packed document — without this, packed
+    # training silently diverges from training the documents unpacked —
+    # and cross-document boundary pairs stay out of the loss.
+    inputs, targets, seg_in, positions, mask = lm_batch_views(batch)
     rngs = {"dropout": rng} if rng is not None else None
-    seg_in = None if seg is None else seg[:, :-1]
     apply_kw = dict(
-        segment_ids=seg_in,
-        # RoPE positions restart per packed document — without this, packed
-        # training silently diverges from training the documents unpacked.
-        positions=None if seg_in is None else packed_positions(seg_in),
+        segment_ids=seg_in, positions=positions,
         deterministic=rng is None, rngs=rngs, attention_fn=attention_fn)
-    mask = batch.get("mask")
-    mask = jnp.ones_like(targets, jnp.float32) if mask is None else mask[:, 1:]
-    if seg is not None:
-        # Position i predicts i+1: only count pairs inside one document.
-        mask = mask * (seg[:, :-1] == seg[:, 1:]).astype(jnp.float32)
 
     if chunked:
         from k8s_distributed_deeplearning_tpu.ops.chunked_ce import (

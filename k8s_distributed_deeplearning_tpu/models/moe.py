@@ -42,7 +42,7 @@ import jax.numpy as jnp
 import optax
 
 from k8s_distributed_deeplearning_tpu.models.transformer import (
-    LMHead, Transformer, TransformerConfig, default_init)
+    LMHead, Transformer, TransformerConfig, default_init, lm_batch_views)
 
 Dtype = Any
 
@@ -249,7 +249,7 @@ class MoEMLP(nn.Module):
     moe: MoEConfig
 
     @nn.compact
-    def __call__(self, x: jax.Array) -> jax.Array:
+    def __call__(self, x: jax.Array, decode: bool = False) -> jax.Array:
         cfg, moe = self.cfg, self.moe
         b, s, d = x.shape
         mlp = cfg.resolved_mlp_dim
@@ -282,6 +282,22 @@ class MoEMLP(nn.Module):
             ye = jnp.einsum("ecm,emd->ecd", h, w_down)
             return nn.with_logical_constraint(ye, ("expert", None, "embed"))
 
+        if decode:
+            # Serving path: DROPLESS top-k via the index dispatch with
+            # capacity = T (no token can overflow a T-deep buffer, so
+            # every token keeps all k choices). The training paths size
+            # capacity from THIS call's token count, so a decode step
+            # (T = B) and a prefill (T = B·S_prompt) would drop different
+            # tokens — routing would depend on call width; with keep
+            # always true each token's output is a function of that token
+            # alone, so incremental decode matches one-shot prefill
+            # exactly (parity-tested). Reuses experts_apply, so the
+            # "expert" logical-axis constraints keep EP sharding at
+            # serving too. Expert-choice models decode through the same
+            # per-token top-k gates (EC's whole-batch token selection has
+            # no causal decode semantics — see the MoELM warning).
+            y, _ = self._index_dispatch(tokens, logits, t, experts_apply)
+            return y.reshape(b, s, d)
         if moe.dispatch == "index":
             y, aux = self._index_dispatch(tokens, logits, capacity,
                                           experts_apply)
@@ -356,27 +372,36 @@ class MoELM(nn.Module):
     """Decoder-only MoE language model (every layer MoE, GShard-dense layout).
 
     Rides the shared :class:`~models.transformer.Transformer` core with
-    ``mlp_factory`` swapping the dense MLP for :class:`MoEMLP`, so scan_layers
-    / remat / dropout all work for MoE exactly as for dense models.
+    ``mlp_factory`` swapping the dense MLP for :class:`MoEMLP`, so
+    scan_layers / remat / dropout / packed ``segment_ids`` /
+    ``decode`` (KV-cache generation via :func:`models.generate.generate`)
+    all work for MoE exactly as for dense models. Decode routes the MoE
+    layers through the DROPLESS per-token path (see ``MoEMLP.__call__``):
+    the capacity paths size buffers from the call's token count, which
+    would make decode-step routing differ from prefill; the dropless path
+    is width-independent, so incremental decode matches one-shot prefill
+    exactly (parity-tested).
 
     .. warning:: ``routing="expert_choice"`` is NON-CAUSAL in this decoder:
        each expert selects its top-C tokens over the whole flattened [B*S]
        batch, so position i's routing depends on future tokens (and other
        batch rows). Training/eval leak future information through the
        routing decision, and autoregressive decode (which cannot see the
-       future) routes differently from training. Prefer ``routing="topk"``
-       (strictly per-token, causal-safe) for LMs; expert choice fits
-       non-causal models (BERT/ViT-style) — Zhou et al. use it for
-       encoders. A warning is emitted at construction when combined with
-       this causal LM.
+       future) routes differently from training (decode falls back to
+       per-token top-k gates). Prefer ``routing="topk"`` (strictly
+       per-token, causal-safe) for LMs; expert choice fits non-causal
+       models (BERT/ViT-style) — Zhou et al. use it for encoders. A
+       warning is emitted at construction when combined with this causal
+       LM.
     """
 
     cfg: TransformerConfig
     moe: MoEConfig
 
     @nn.compact
-    def __call__(self, tokens, *, positions=None, attention_fn=None,
-                 deterministic: bool = True):
+    def __call__(self, tokens, *, positions=None, segment_ids=None,
+                 attention_fn=None, deterministic: bool = True,
+                 decode: bool = False):
         if self.moe.routing == "expert_choice":
             warnings.warn(
                 "expert_choice routing inside a causal LM is non-causal: "
@@ -387,8 +412,9 @@ class MoELM(nn.Module):
                 UserWarning, stacklevel=2)
         factory = functools.partial(MoEMLP, moe=self.moe)
         x = Transformer(self.cfg, mlp_factory=factory, name="transformer")(
-            tokens, positions=positions, deterministic=deterministic,
-            attention_fn=attention_fn)
+            tokens, positions=positions, segment_ids=segment_ids,
+            deterministic=deterministic,
+            attention_fn=attention_fn, decode=decode)
         return LMHead(self.cfg, name="head")(x)
 
 
@@ -419,12 +445,25 @@ def flops_per_token(cfg: TransformerConfig, moe: MoEConfig, *,
 
 
 def loss_fn(model: MoELM, moe: MoEConfig, params, batch, rng=None):
-    """Next-token CE + load-balance and router-z auxiliary losses."""
-    tokens = batch["tokens"]
-    inputs, targets = tokens[:, :-1], tokens[:, 1:]
-    logits, state = model.apply({"params": params}, inputs,
-                                mutable=["intermediates"])
-    ce = optax.softmax_cross_entropy_with_integer_labels(logits, targets).mean()
+    """Next-token CE + load-balance and router-z auxiliary losses.
+
+    ``batch``: {"tokens": [B,S] int32, optional "mask": [B,S] 1.0 = count
+    this position, optional "segment_ids": [B,S] packed-document ids} —
+    the same packed contract as ``llama.loss_fn`` — one shared preamble,
+    :func:`models.transformer.lm_batch_views` (segment-masked attention,
+    per-document RoPE restarts, cross-document boundary pairs out of the
+    loss). Note the routing itself is per-token but capacity contention is
+    batch-global, so packing changes WHICH tokens drop under pressure —
+    the same property any batch composition has for MoE."""
+    inputs, targets, seg_in, positions, mask = lm_batch_views(batch)
+    rngs = {"dropout": rng} if rng is not None else None
+    logits, state = model.apply(
+        {"params": params}, inputs, segment_ids=seg_in, positions=positions,
+        deterministic=rng is None, rngs=rngs,
+        mutable=["intermediates"])
+    ce_tok = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
+    denom = jnp.maximum(mask.sum(), 1.0)
+    ce = (ce_tok * mask).sum() / denom
     flat = jax.tree_util.tree_flatten_with_path(state["intermediates"])[0]
     lb = [v for path, v in flat if "load_balance_loss" in str(path)]
     zs = [v for path, v in flat if "router_z_loss" in str(path)]
@@ -434,5 +473,5 @@ def loss_fn(model: MoELM, moe: MoEConfig, params, batch, rng=None):
     aux_loss = (moe.aux_loss_weight * sum(jnp.sum(l) for l in lb)
                 + moe.router_z_weight * sum(jnp.sum(z) for z in zs))
     loss = ce + aux_loss
-    acc = (logits.argmax(-1) == targets).mean()
+    acc = ((logits.argmax(-1) == targets) * mask).sum() / denom
     return loss, {"ce": ce, "aux_loss": aux_loss, "accuracy": acc}

@@ -159,6 +159,26 @@ def packed_positions(segment_ids: jax.Array) -> jax.Array:
     return idx - doc_start
 
 
+def lm_batch_views(batch) -> tuple:
+    """Shared next-token-LM batch preamble: shift tokens (position i
+    predicts i+1), slice packed segment ids, derive per-document positions,
+    and build the loss mask (optional caller "mask" ∧ cross-document
+    boundary-pair exclusion). ONE definition so the llama and MoE losses
+    cannot drift. Returns (inputs, targets, seg_in, positions, mask);
+    seg_in/positions are None for unpacked batches."""
+    tokens = batch["tokens"]
+    inputs, targets = tokens[:, :-1], tokens[:, 1:]
+    seg = batch.get("segment_ids")
+    seg_in = None if seg is None else seg[:, :-1]
+    positions = None if seg_in is None else packed_positions(seg_in)
+    mask = batch.get("mask")
+    mask = (jnp.ones_like(targets, jnp.float32) if mask is None
+            else mask[:, 1:])
+    if seg is not None:
+        mask = mask * (seg[:, :-1] == seg[:, 1:]).astype(jnp.float32)
+    return inputs, targets, seg_in, positions, mask
+
+
 def rope_frequencies(head_dim: int, max_seq_len: int,
                      theta: float) -> tuple[jax.Array, jax.Array]:
     """Precompute RoPE cos/sin tables, shape [max_seq_len, head_dim/2], f32."""
@@ -365,6 +385,10 @@ class Block(nn.Module):
     ``mlp_factory(cfg, name=...)`` swaps the feed-forward module (e.g. the
     expert-parallel :class:`models.moe.MoEMLP`) while keeping the block's
     norm/residual/dropout structure — and therefore scan/remat — shared.
+    Factory-provided modules must accept a ``decode`` keyword (the static
+    mode flag rides to them so e.g. MoE can switch to its dropless
+    serving dispatch); the plain :class:`MLP` is mode-independent and is
+    called without it.
     """
 
     cfg: TransformerConfig
@@ -388,7 +412,10 @@ class Block(nn.Module):
             h = nn.Dropout(cfg.dropout_rate, deterministic=deterministic)(h)
         x = x + h
         h = make_norm(cfg, "mlp_norm")(x)
-        h = (self.mlp_factory or MLP)(cfg, name="mlp")(h)
+        if self.mlp_factory is not None:
+            h = self.mlp_factory(cfg, name="mlp")(h, decode=decode)
+        else:
+            h = MLP(cfg, name="mlp")(h)
         if cfg.dropout_rate:
             h = nn.Dropout(cfg.dropout_rate, deterministic=deterministic)(h)
         x = x + h
