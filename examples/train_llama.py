@@ -116,6 +116,16 @@ def main(argv: list[str] | None = None) -> dict:
     parser.add_argument("--pp-virtual", type=int, default=2,
                         help="virtual chunks per stage for "
                         "--pp-schedule interleaved")
+    parser.add_argument("--moe-experts", type=int, default=0,
+                        help="swap every MLP for a mixture-of-experts layer "
+                        "with N experts (models/moe.py MoELM; 0 = dense). "
+                        "Composes with --pack/--sp/--fsdp/--tp/--ep; "
+                        "not with --pp or --chunked-ce")
+    parser.add_argument("--moe-top-k", type=int, default=2)
+    parser.add_argument("--moe-capacity-factor", type=float, default=1.25)
+    parser.add_argument("--ep", type=int, default=1,
+                        help="expert-parallel mesh axis (shards the "
+                        "'expert' logical axis of MoE weights/buffers)")
     parser.add_argument("--attention",
                         choices=["auto", "xla", "flash", "ring", "ulysses"],
                         default="auto",
@@ -182,12 +192,26 @@ def main(argv: list[str] | None = None) -> dict:
         # it in the mesh even at size 1 when CP attention is requested.
         mesh = mesh_lib.make_mesh(cfg.MeshConfig(
             data=args.dp, fsdp=args.fsdp, tensor=args.tp,
-            sequence=args.sp).to_axis_sizes(
+            sequence=args.sp, expert=args.ep).to_axis_sizes(
                 keep=("sequence",) if use_cp else ()))
 
     model_cfg = build_config(args)
     seq_len = args.seq_len or min(model_cfg.max_seq_len, 512)
-    model = llama.LlamaLM(model_cfg)
+    moe_cfg = None
+    if args.moe_experts:
+        if use_pp:
+            raise ValueError(
+                "--moe-experts does not compose with --pp: the pipeline "
+                "block adapter builds dense Blocks, so it would silently "
+                "train a dense model — use the sharded-trainer axes "
+                "(--dp/--fsdp/--tp/--sp) for MoE")
+        from k8s_distributed_deeplearning_tpu.models import moe as moe_lib
+        moe_cfg = moe_lib.MoEConfig(
+            num_experts=args.moe_experts, top_k=args.moe_top_k,
+            capacity_factor=args.moe_capacity_factor)
+        model = moe_lib.MoELM(model_cfg, moe_cfg)
+    else:
+        model = llama.LlamaLM(model_cfg)
 
     attention_fn = None
     cp_impl = cp_inner = None
@@ -209,9 +233,11 @@ def main(argv: list[str] | None = None) -> dict:
             mesh, cp_impl, inner_impl=cp_inner)
 
     # Chunked CE defaults on for the 8B preset, where the [B,S,V] logits
-    # tensor (V=128256) is the single largest activation in the step.
+    # tensor (V=128256) is the single largest activation in the step —
+    # except for MoE runs (MoELM has no chunked-head path), where the
+    # default stays off and only an EXPLICIT --chunked-ce errors.
     chunked = (args.chunked_ce if args.chunked_ce is not None
-               else args.preset == "8b")
+               else (args.preset == "8b" and not args.moe_experts))
 
     # LM convention: --num-steps is the optimizer-step budget as given (the
     # reference's steps//world rule, tensorflow_mnist.py:146, presumes a fixed
@@ -238,9 +264,21 @@ def main(argv: list[str] | None = None) -> dict:
             raise ValueError("--grad-accum with --pp: raise --pp-microbatches "
                              "instead (the pipeline already microbatches)")
     else:
-        def loss(params, batch, rng):
-            return llama.loss_fn(model, params, batch, rng,
-                                 attention_fn=attention_fn, chunked=chunked)
+        if moe_cfg is not None:
+            if chunked:
+                raise ValueError(
+                    "--chunked-ce is not supported with --moe-experts "
+                    "(MoELM has no chunked-head path); drop one of them")
+
+            def loss(params, batch, rng):
+                # moe_lib bound where moe_cfg was built (same function).
+                return moe_lib.loss_fn(model, moe_cfg, params, batch, rng,
+                                       attention_fn=attention_fn)
+        else:
+            def loss(params, batch, rng):
+                return llama.loss_fn(model, params, batch, rng,
+                                     attention_fn=attention_fn,
+                                     chunked=chunked)
         trainer = sharding.ShardedTrainer(loss, optimizer, mesh)
         state = trainer.init(init, jax.random.key(conf.seed))
         step_fn = trainer.make_step(donate=True, microbatches=conf.grad_accum)
@@ -344,6 +382,10 @@ def main(argv: list[str] | None = None) -> dict:
                  attention=args.attention,
                  **({"cp_impl": cp_impl, "cp_inner": cp_inner}
                     if cp_impl else {}),
+                 **({"moe": {"experts": moe_cfg.num_experts,
+                             "top_k": moe_cfg.top_k,
+                             "capacity_factor": moe_cfg.capacity_factor}}
+                    if moe_cfg is not None else {}),
                  **metrics_extra,
                  platform=topo.platform)
 
@@ -353,8 +395,12 @@ def main(argv: list[str] | None = None) -> dict:
         return prefetch.maybe(batcher.iter_from(start_step),
                               trainer.shard_batch, args.prefetch, prefetchers)
 
-    flops_per_example = llama.flops_per_token(model_cfg,
-                                              seq_len=seq_len) * seq_len
+    if moe_cfg is not None:
+        flops_per_example = moe_lib.flops_per_token(
+            model_cfg, moe_cfg, seq_len=seq_len) * seq_len
+    else:
+        flops_per_example = llama.flops_per_token(model_cfg,
+                                                  seq_len=seq_len) * seq_len
     eval_fn = None
     if conf.eval_every:
         eval_loss = make_eval_loss_fn()

@@ -293,10 +293,16 @@ class MoEMLP(nn.Module):
             # alone, so incremental decode matches one-shot prefill
             # exactly (parity-tested). Reuses experts_apply, so the
             # "expert" logical-axis constraints keep EP sharding at
-            # serving too. Expert-choice models decode through the same
-            # per-token top-k gates (EC's whole-batch token selection has
-            # no causal decode semantics — see the MoELM warning).
-            y, _ = self._index_dispatch(tokens, logits, t, experts_apply)
+            # serving too. routing="topk" is FORCED: expert choice's
+            # whole-batch token selection has no causal decode semantics
+            # (see the MoELM warning), so EC models decode through the
+            # same per-token top-k gates. Cost note: the [E, T, d]
+            # buffers make prefill MLP work scale with E rather than the
+            # training path's capacity_factor·k slots (~E/(k·cf)× FLOPs,
+            # mostly zero rows) — the price of exact width-independent
+            # routing; decode steps (T = B) are unaffected.
+            y, _ = self._index_dispatch(tokens, logits, t, experts_apply,
+                                        routing="topk")
             return y.reshape(b, s, d)
         if moe.dispatch == "index":
             y, aux = self._index_dispatch(tokens, logits, capacity,
@@ -324,16 +330,18 @@ class MoEMLP(nn.Module):
         y = jnp.einsum("tec,ecd->td", combine.astype(cfg.dtype), ye)
         return y, aux
 
-    def _index_dispatch(self, tokens, logits, capacity, experts_apply):
+    def _index_dispatch(self, tokens, logits, capacity, experts_apply,
+                        routing=None):
         """Index-based scatter/gather dispatch — O(T·k·d) data movement
         instead of the dense path's T·E·C·d dispatch/combine MACs,
-        identical routing semantics (parity-tested)."""
+        identical routing semantics (parity-tested). *routing* overrides
+        the config's assignment policy (the decode path forces "topk")."""
         cfg, moe = self.cfg, self.moe
         t, d = tokens.shape
         e = moe.num_experts
         tok_c = tokens.astype(cfg.dtype)
 
-        if moe.routing == "expert_choice":
+        if (routing or moe.routing) == "expert_choice":
             gates, idx = _expert_choice_picks(logits, capacity)   # [E, C]
             sel = idx.reshape(-1)
             xe = jnp.take(tok_c, sel, axis=0).reshape(e, capacity, d)
@@ -444,7 +452,8 @@ def flops_per_token(cfg: TransformerConfig, moe: MoEConfig, *,
     return dense + cfg.n_layers * (mlp_term * (active - 1) + router)
 
 
-def loss_fn(model: MoELM, moe: MoEConfig, params, batch, rng=None):
+def loss_fn(model: MoELM, moe: MoEConfig, params, batch, rng=None, *,
+            attention_fn=None):
     """Next-token CE + load-balance and router-z auxiliary losses.
 
     ``batch``: {"tokens": [B,S] int32, optional "mask": [B,S] 1.0 = count
@@ -459,7 +468,7 @@ def loss_fn(model: MoELM, moe: MoEConfig, params, batch, rng=None):
     rngs = {"dropout": rng} if rng is not None else None
     logits, state = model.apply(
         {"params": params}, inputs, segment_ids=seg_in, positions=positions,
-        deterministic=rng is None, rngs=rngs,
+        deterministic=rng is None, rngs=rngs, attention_fn=attention_fn,
         mutable=["intermediates"])
     ce_tok = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
     denom = jnp.maximum(mask.sum(), 1.0)

@@ -326,14 +326,19 @@ def test_expert_choice_capacity_exceeding_tokens_clamps():
 
 # --- MoE x decode / packed (late round 4: MoELM gains the full LM surface) --
 
-def test_moe_incremental_decode_matches_one_shot_prefill():
+@pytest.mark.parametrize("routing", ["topk", "expert_choice"])
+def test_moe_incremental_decode_matches_one_shot_prefill(routing):
     """KV-cache decode on an MoE LM: feeding the prompt token-by-token must
     reproduce the one-shot prefill logits. The MoE layers use the DROPLESS
     per-token path at decode (capacity buffers are sized per call, so the
     capacity paths would route a 1-token step differently than a prefill —
-    the dropless path is width-independent by construction)."""
+    the dropless path is width-independent by construction). Expert-choice
+    models decode through the same forced per-token top-k gates (EC's
+    whole-batch selection has no causal decode semantics), so the parity
+    holds for both routings."""
     cfg = llama.config_tiny(dtype=jnp.float32, max_seq_len=32)
-    mcfg = moe.MoEConfig(num_experts=4, top_k=2, capacity_factor=2.0)
+    mcfg = moe.MoEConfig(num_experts=4, top_k=2, capacity_factor=2.0,
+                         routing=routing)
     model = moe.MoELM(cfg, mcfg)
     toks = jax.random.randint(jax.random.key(0), (2, 10), 0, cfg.vocab_size)
     params = model.init(jax.random.key(1), toks)["params"]

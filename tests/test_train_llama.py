@@ -184,3 +184,31 @@ def test_training_is_deterministic_from_seed(mesh8):
         return losses
 
     assert run() == run()
+
+
+@pytest.mark.slow
+def test_train_llama_moe_cli(tmp_path):
+    """--moe-experts: packed MoE training through the full flagship CLI
+    (MoELM + moe.loss_fn, aux losses in the metrics, MoE flops for MFU) —
+    the API-level MoE surface reachable from the deployed entry point."""
+    import train_llama
+    result = train_llama.main([
+        "--preset", "tiny", "--dp", "8", "--moe-experts", "4", "--pack",
+        "--num-steps", "10", "--batch-size", "8", "--seq-len", "128",
+        "--log-every", "5", "--checkpoint-dir", str(tmp_path / "ck"),
+        "--checkpoint-every", "1000",
+    ])
+    assert result["num_steps"] == 10
+    assert np.isfinite(result["eval_loss"])
+
+
+def test_train_llama_moe_flag_conflicts():
+    import train_llama
+    with pytest.raises(ValueError, match="does not compose with --pp"):
+        train_llama.main([
+            "--preset", "tiny", "--pp", "2", "--dp", "4",
+            "--moe-experts", "4", "--num-steps", "2"])
+    with pytest.raises(ValueError, match="chunked-ce is not supported"):
+        train_llama.main([
+            "--preset", "tiny", "--dp", "8", "--moe-experts", "4",
+            "--chunked-ce", "--num-steps", "2"])
