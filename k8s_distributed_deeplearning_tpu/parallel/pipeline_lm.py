@@ -74,33 +74,26 @@ def _check_supported(cfg: tfm.TransformerConfig):
 
 
 def _position_indices(cfg: tfm.TransformerConfig, inputs: jax.Array,
-                      segment_ids: jax.Array | None) -> jax.Array | None:
+                      segment_ids: jax.Array | None,
+                      packed_pos: jax.Array | None = None
+                      ) -> jax.Array | None:
     """Learned-position embedding indices, or None for rope/none models:
     absolute 0..S-1 normally, per-document restarts for packed rows — the
     same contract the non-pipelined core applies at embed time
-    (models/transformer.py Transformer.__call__)."""
+    (models/transformer.py Transformer.__call__). *packed_pos* passes
+    positions a caller already derived (lm_batch_views) so they aren't
+    recomputed."""
     if cfg.position != "learned":
         return None
     if segment_ids is not None:
-        return tfm.packed_positions(segment_ids)
+        return (packed_pos if packed_pos is not None
+                else tfm.packed_positions(segment_ids))
     return jnp.broadcast_to(jnp.arange(inputs.shape[1]), inputs.shape)
 
 
-def _prepare_lm_batch(batch: PyTree):
-    """Shared next-token-CE batch preamble for both schedules: shift,
-    default mask, and (packed) cross-document boundary exclusion — one copy
-    so the gpipe and 1f1b losses cannot drift."""
-    tokens = batch["tokens"]
-    inputs, targets = tokens[:, :-1], tokens[:, 1:]
-    seg = batch.get("segment_ids")
-    seg_in = None if seg is None else seg[:, :-1]
-    mask = batch.get("mask")
-    mask = (jnp.ones_like(targets, jnp.float32) if mask is None
-            else mask[:, 1:])
-    if seg is not None:
-        # Position i predicts i+1: only count pairs inside one document.
-        mask = mask * (seg[:, :-1] == seg[:, 1:]).astype(jnp.float32)
-    return inputs, targets, seg_in, mask
+# The next-token batch preamble (shift, mask, boundary exclusion, packed
+# positions) is the SHARED tfm.lm_batch_views — one definition across the
+# llama/moe losses and both pipeline engines, so they cannot drift.
 
 
 def _head_logits(x: jax.Array, w: jax.Array, layout: str,
@@ -265,7 +258,7 @@ class PipelineTrainer:
             axis_name=axis_name, data_axes=data_axes)
 
     # -- placement ---------------------------------------------------------
-    def _spec_for_path(self, path, leaf=None) -> P:
+    def _spec_for_path(self, path, leaf) -> P:
         """Sharding spec for one state leaf. Block leaves shard over the
         pipeline axis ONLY when their shape actually carries the layer
         stack — optimizer states can hold degenerate stand-in leaves under
@@ -275,14 +268,14 @@ class PipelineTrainer:
         if "blocks" not in keys:
             return P()
         stages = self.mesh.shape[self.axis_name]
-        ndim = getattr(leaf, "ndim", None)
+        ndim = getattr(leaf, "ndim", 0)
         if self.schedule == "interleaved":
             # [V, P, L/(PV), ...]: shard the device dim.
-            if ndim is None or (ndim >= 2 and leaf.shape[1] == stages):
+            if ndim >= 2 and leaf.shape[1] == stages:
                 return P(None, self.axis_name)
             return P()
-        if ndim is None or (ndim >= 1 and leaf.shape[0] >= stages
-                            and leaf.shape[0] % stages == 0):
+        if ndim >= 1 and leaf.shape[0] >= stages \
+                and leaf.shape[0] % stages == 0:
             return P(self.axis_name)     # stacked layer axis -> stage shard
         return P()
 
@@ -420,7 +413,7 @@ class PipelineTrainer:
         # stochastic compiled variant.
         if not self.model.cfg.dropout_rate:
             rng = None
-        inputs, targets, seg_in, mask = _prepare_lm_batch(batch)
+        inputs, targets, seg_in, _, mask = tfm.lm_batch_views(batch)
 
         if self.chunked_ce:
             from k8s_distributed_deeplearning_tpu.ops.chunked_ce import (
@@ -511,7 +504,7 @@ class PipelineTrainer:
         if not cfg.dropout_rate:
             rng = None
         params = nn.meta.unbox(params)
-        inputs, targets, seg_in, mask = _prepare_lm_batch(batch)
+        inputs, targets, seg_in, packed_pos, mask = tfm.lm_batch_views(batch)
         total_mask = jnp.maximum(mask.sum(), 1.0)   # known pre-schedule
 
         tp = params["transformer"]
@@ -560,16 +553,14 @@ class PipelineTrainer:
 
         emb = tp["tok_embed"]["embedding"]
         x = jnp.take(emb, inputs, axis=0).astype(cfg.dtype)
-        pos_idx = _position_indices(cfg, inputs, seg_in)
+        pos_idx = _position_indices(cfg, inputs, seg_in, packed_pos)
         pos_tab = tp["pos_embed"]["embedding"] if pos_idx is not None else None
         if pos_idx is not None:
             x = x + jnp.take(pos_tab, pos_idx, axis=0).astype(cfg.dtype)
         aux_tree = {"targets": targets, "mask": mask}
         args = [tp["blocks"], head_side, x, aux_tree, total_mask]
         if packed:
-            args.append({"segment_ids": seg_in,
-                         "positions": pos_idx if pos_idx is not None
-                         else tfm.packed_positions(seg_in)})
+            args.append({"segment_ids": seg_in, "positions": packed_pos})
         if stochastic:
             args.append(rng)
         loss, metrics, g_blocks, g_head, dx = sharded(*args)
