@@ -14,6 +14,16 @@ TPU-first with two dispatch mechanisms, both fully static-shaped:
 - ``dispatch="einsum"``: the Switch-style dense one-hot formulation,
   retained as the readable reference both for parity tests and for meshes
   where a contraction lowers better than scatter.
+- ``dispatch="ragged"``: DROPLESS grouped-GEMM dispatch (round 5) — tokens
+  scatter into one flat buffer sorted by expert (block-aligned ragged
+  layout, no per-expert capacity padding) and the expert MLP runs as three
+  Pallas grouped matmuls (:mod:`ops.pallas_gmm`) whose per-expert MXU work
+  is proportional to REAL tokens. Removes both the ≥20% zero-padding the
+  capacity buffers multiply at cf=1.25 and the capacity-overflow drops.
+  Single-shard expert compute: under an expert-sharded mesh XLA cannot
+  partition through the kernel (use ``"index"`` there — the EP dryrun
+  does); the win is the dense-expert/data-parallel regime the MoE bench
+  measures.
 
 Expert parallelism falls out of the logical-axis system: expert weights carry
 the "expert" logical axis -> the rule table maps it to the "expert" mesh axis
@@ -68,15 +78,22 @@ class MoEConfig:
     aux_loss_weight: float = 0.01
     router_z_weight: float = 1e-3
     routing: str = "topk"            # "topk" | "expert_choice"
-    dispatch: str = "index"          # "index" | "einsum" (see module docstring)
+    dispatch: str = "index"          # "index" | "einsum" | "ragged"
+    ragged_block_m: int = 512        # grouped-GEMM row block (see pallas_gmm)
 
     def __post_init__(self):
         if self.routing not in ("topk", "expert_choice"):
             raise ValueError(f"routing must be 'topk' or 'expert_choice', "
                              f"got {self.routing!r}")
-        if self.dispatch not in ("index", "einsum"):
-            raise ValueError(f"dispatch must be 'index' or 'einsum', "
-                             f"got {self.dispatch!r}")
+        if self.dispatch not in ("index", "einsum", "ragged"):
+            raise ValueError(f"dispatch must be 'index', 'einsum' or "
+                             f"'ragged', got {self.dispatch!r}")
+        if self.dispatch == "ragged" and self.routing == "expert_choice":
+            raise ValueError(
+                "dispatch='ragged' targets top-k routing: expert choice "
+                "already runs every expert exactly full (its [E, C, d] "
+                "buffers carry no capacity padding), so the grouped GEMM "
+                "has nothing to reclaim — use dispatch='index'.")
 
 
 def clamped_capacity(tokens: int, moe: "MoEConfig") -> int:
@@ -304,7 +321,10 @@ class MoEMLP(nn.Module):
             y, _ = self._index_dispatch(tokens, logits, t, experts_apply,
                                         routing="topk")
             return y.reshape(b, s, d)
-        if moe.dispatch == "index":
+        if moe.dispatch == "ragged":
+            y, aux = self._ragged_dispatch(tokens, logits,
+                                           w_gate, w_up, w_down)
+        elif moe.dispatch == "index":
             y, aux = self._index_dispatch(tokens, logits, capacity,
                                           experts_apply)
         else:
@@ -375,6 +395,61 @@ class MoEMLP(nn.Module):
                              axis=0) * w
         return y, aux
 
+    def _ragged_dispatch(self, tokens, logits, w_gate, w_up, w_down):
+        """Dropless grouped-GEMM dispatch (``ops.pallas_gmm``): tokens
+        scatter into one flat [M_pad, d] buffer sorted by expert
+        (block-aligned ragged layout — the SAME cumsum position accounting
+        as the capacity paths, just with per-expert ragged offsets instead
+        of a fixed-capacity clamp) and the expert SwiGLU runs as three
+        grouped matmuls whose MXU work tracks real token counts. No
+        capacity ⇒ no overflow drops and no zero-padding compute."""
+        from k8s_distributed_deeplearning_tpu.ops import pallas_gmm
+
+        cfg, moe = self.cfg, self.moe
+        t, d = tokens.shape
+        k = moe.top_k
+        tok_c = tokens.astype(cfg.dtype)
+
+        probs, idx_list, assign, gate_stack = _topk_assignments(logits, k)
+        counts = functools.reduce(
+            lambda a, b: a + b, (jnp.sum(a, axis=0) for a in assign))
+        layout = pallas_gmm.grouped_layout(
+            counts.astype(jnp.int32), t * k, block_m=moe.ragged_block_m)
+
+        used = jnp.zeros((moe.num_experts,), jnp.float32)
+        dests = []
+        for c in range(k):
+            one_hot = assign[c]                                   # [T, E]
+            pos = jnp.cumsum(one_hot, axis=0) - one_hot + used
+            used = used + jnp.sum(one_hot, axis=0)
+            pos_t = jnp.sum(pos * one_hot, axis=-1).astype(jnp.int32)
+            dests.append(layout.row_offset[idx_list[c]] + pos_t)
+
+        # Destinations are unique across tokens AND choices (one row per
+        # (expert, position)), so add ≡ set — and add's VJP is just a
+        # gather, where set's pays an extra zeroing scatter on the base.
+        # Padding rows stay zero (the gmm contract relies on this).
+        xs = jnp.zeros((layout.m_pad, d), cfg.dtype)
+        for c in range(k):
+            xs = xs.at[dests[c]].add(tok_c, mode="drop",
+                                     unique_indices=True)
+        # checkpoint_name: a Pallas call is not a dot XLA's remat policy
+        # can match, so without the tag remat policies that save matmul
+        # outputs would recompute all three grouped GEMMs in the backward
+        # (see REMAT_POLICIES in models/transformer.py).
+        from jax.ad_checkpoint import checkpoint_name
+        gmm = lambda x, w: checkpoint_name(
+            pallas_gmm.gmm(x, w, layout), "gmm_out")
+        h = nn.silu(gmm(xs, w_gate)) * gmm(xs, w_up)
+        ys = gmm(h, w_down)
+        y = jnp.zeros((t, d), cfg.dtype)
+        for c in range(k):
+            y = y + (jnp.take(ys, dests[c], axis=0)
+                     * gate_stack[c][:, None].astype(cfg.dtype))
+        aux = dict(_router_aux(logits, probs, assign[0]),
+                   fraction_dropped=jnp.zeros((), jnp.float32))
+        return y, aux
+
 
 class MoELM(nn.Module):
     """Decoder-only MoE language model (every layer MoE, GShard-dense layout).
@@ -441,6 +516,11 @@ def flops_per_token(cfg: TransformerConfig, moe: MoEConfig, *,
     from k8s_distributed_deeplearning_tpu.models import transformer
     dense = transformer.flops_per_token(cfg, seq_len=seq_len)
     mlp_term = 3.0 * 3 * 2 * cfg.dim * cfg.resolved_mlp_dim   # swiglu, x3 fwd+bwd
+    if moe.dispatch == "ragged":
+        # Dropless grouped GEMM: exactly top_k expert slots per token —
+        # no capacity padding to count, no drops to ignore (the ≤1-block
+        # per-expert round-up slack is skipped or multiplies zeros).
+        tokens_per_batch = None
     if tokens_per_batch is not None:
         t = tokens_per_batch
         capacity = clamped_capacity(t, moe)   # the exact MoEMLP formula
