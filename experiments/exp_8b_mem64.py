@@ -1,0 +1,118 @@
+"""8B per-device HBM proof on a v5p-64-SHAPED virtual mesh (64 CPU
+devices, dp8 x fsdp8) — the numbers behind BASELINE.md's 8B row.
+
+Compiles the REAL train step (chunked CE, remat, adafactor, donation) at
+2 and 4 layers from abstract state (no arrays materialize), reads XLA's
+memory_analysis(), extrapolates the 32-layer working set per device.
+Run: python experiments/exp_8b_mem64.py   (prints one JSON line)
+"""
+import json
+import os
+import sys
+
+os.environ["JAX_PLATFORM_NAME"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+os.environ["XLA_FLAGS"] = (flags +
+                           " --xla_force_host_platform_device_count=64")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+import jax._src.xla_bridge as _xb  # noqa: E402
+
+_xb._backend_factories.pop("axon", None)
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_platform_name", "cpu")
+
+import flax.linen as nn  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import optax  # noqa: E402
+
+from k8s_distributed_deeplearning_tpu.models import llama  # noqa: E402
+from k8s_distributed_deeplearning_tpu.models.llama import loss_fn  # noqa: E402
+from k8s_distributed_deeplearning_tpu.parallel import mesh as mesh_lib  # noqa: E402
+from k8s_distributed_deeplearning_tpu.parallel import sharding  # noqa: E402
+
+B, S = 64, 4096   # one sequence per chip at dp8 x fsdp8
+
+
+def compiled_mem(n_layers):
+    # bf16 + flash: the config the real machine runs. The first cut of
+    # this experiment measured f32 defaults + the XLA einsum attention
+    # (what impl="auto" picks on CPU hosts) and read 65 GB/dev temp at 2
+    # LAYERS — almost entirely f32 [8,32,4096,4096] score tensors that
+    # (a) the flash kernel never materializes on TPU and (b) GSPMD had
+    # REPLICATED across the fsdp axis (batch propagated 8-way, not
+    # 64-way, inside the unconstrained attention einsums; tp2 halved it
+    # by sharding heads, confirming). Known issue recorded in
+    # BENCHMARKS.md round 5: the XLA attention path carries no logical
+    # constraint on its internal scores, so on fsdp-heavy meshes its
+    # memory can replicate — flagship TPU configs take the flash path
+    # and never hit it.
+    cfg = llama.config_llama3_8b(n_layers=n_layers, max_seq_len=S,
+                                 remat=True, dtype=jnp.bfloat16,
+                                 attention_impl="flash")
+    model = llama.LlamaLM(cfg)
+    mesh = mesh_lib.make_mesh({"data": 8, "fsdp": 8})
+    optimizer = optax.adafactor(1e-4)
+    # shard_map'd attention: without it GSPMD replicates the flash call
+    # on every device (see ops.attention.make_mesh_attention_fn).
+    from k8s_distributed_deeplearning_tpu.ops import attention as att_ops
+    att_fn = att_ops.make_mesh_attention_fn(mesh, impl=cfg.attention_impl)
+
+    def loss(p, b, r):
+        return loss_fn(model, p, b, r, chunked=True, chunk_size=512,
+                       attention_fn=att_fn)
+
+    def make_state(r):
+        params = model.init(r, jnp.zeros((1, 8), jnp.int32))["params"]
+        from k8s_distributed_deeplearning_tpu.parallel.data_parallel import (
+            TrainState)
+        return TrainState(params=params, opt_state=optimizer.init(params),
+                          step=jnp.zeros((), jnp.int32))
+
+    with mesh, nn.logical_axis_rules(sharding.resolve_rules(mesh)):
+        abstract = jax.eval_shape(make_state, jax.random.key(0))
+        shardings = sharding.state_shardings(abstract, mesh)
+    tr = sharding.ShardedTrainer(loss, optimizer, mesh)
+    tr._state_sh = shardings
+    step = tr.make_step(donate=True)
+    state_sh = jax.tree.map(
+        lambda a, s: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=s),
+        abstract, shardings,
+        is_leaf=lambda x: hasattr(x, "shape") and not isinstance(x, dict))
+    toks = jax.ShapeDtypeStruct(
+        (B, S + 1), jnp.int32,
+        sharding=jax.sharding.NamedSharding(
+            mesh, jax.sharding.PartitionSpec(("data", "fsdp"))))
+    lowered = step.lower(state_sh, {"tokens": toks}, jax.random.key(0))
+    return lowered.compile().memory_analysis()
+
+
+def main():
+    ma2, ma4 = compiled_mem(2), compiled_mem(4)
+    args2, args4 = ma2.argument_size_in_bytes, ma4.argument_size_in_bytes
+    t2, t4 = ma2.temp_size_in_bytes, ma4.temp_size_in_bytes
+    per_layer_args = (args4 - args2) // 2
+    per_layer_temp = max(0, (t4 - t2) // 2)
+    full_args = args2 + 30 * per_layer_args
+    full_temp = t2 + 30 * per_layer_temp
+    print(json.dumps({
+        "mesh": "dp8 x fsdp8 (64 virtual devices, v5p-64 shape)",
+        "batch": B, "seq": S,
+        "gb_per_dev_2l": {"args": round(args2 / 1e9, 2),
+                          "temp": round(t2 / 1e9, 2)},
+        "gb_per_dev_4l": {"args": round(args4 / 1e9, 2),
+                          "temp": round(t4 / 1e9, 2)},
+        "per_layer_gb": {"args": round(per_layer_args / 1e9, 3),
+                         "temp": round(per_layer_temp / 1e9, 3)},
+        "extrapolated_32l_gb_per_dev": {
+            "args": round(full_args / 1e9, 2),
+            "temp": round(full_temp / 1e9, 2),
+            "total": round((full_args + full_temp) / 1e9, 2)},
+        "v5p_hbm_gb": 95,
+        "fits_80pct_budget": bool(full_args + full_temp < 95e9 * 0.8),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -48,10 +48,24 @@ embed_init = nn.initializers.normal(stddev=0.02)
 # "nothing" recomputes everything (minimal memory). Shared by the
 # scan/remat stack here and the pipeline engine's per-layer checkpointing
 # (parallel/pipeline_lm.py).
+#
+# "dots" also saves outputs tagged "gmm_out" — the MoE grouped-GEMM
+# (ops/pallas_gmm, a Pallas call, so not a dot the policy's matcher can
+# see) is exactly the MXU work the policy exists to keep. Measured within
+# noise on the ragged 8-expert bench config (the kernel's custom VJP
+# already stashes its operands, so the backward never re-runs a GEMM
+# either way); the tag keeps the policy's meaning consistent — "matmul
+# outputs are saved" — for remat styles that would otherwise replay the
+# whole MLP. Dense models sow no such name: their residual set is
+# unchanged.
+_SAVE_GMM = jax.checkpoint_policies.save_only_these_names("gmm_out")
 REMAT_POLICIES = {
-    "dots": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+    "dots": jax.checkpoint_policies.save_from_both_policies(
+        jax.checkpoint_policies.dots_with_no_batch_dims_saveable, _SAVE_GMM),
     "dots_attn": jax.checkpoint_policies.save_from_both_policies(
-        jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+        jax.checkpoint_policies.save_from_both_policies(
+            jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+            _SAVE_GMM),
         jax.checkpoint_policies.save_only_these_names("attn_out")),
     "nothing": jax.checkpoint_policies.nothing_saveable,
 }
@@ -260,10 +274,18 @@ class Attention(nn.Module):
                     "silently wrong")
             b, sq = x.shape[0], x.shape[1]
             kv = cfg.resolved_kv_heads
+            # Cache layout [B, S, kv·hd] — heads FOLDED into the lane dim.
+            # The natural [B, S, kv, hd] layout tiles its (kv, hd) minors
+            # to (8, 128): at 4 KV heads × head_dim 64 the buffer occupies
+            # 4× its logical bytes, and the per-step update measured
+            # ~82 µs (a full padded-buffer copy at HBM rate — the decode
+            # trace's top non-matmul cost). Folded, the same update
+            # measures 3.9 µs (in-place sliver write, no padding); the
+            # attention-side unfold is a cheap view (round 5).
             cached_k = self.variable("cache", "cached_key", jnp.zeros,
-                                     (b, cfg.max_seq_len, kv, hd), cfg.dtype)
+                                     (b, cfg.max_seq_len, kv * hd), cfg.dtype)
             cached_v = self.variable("cache", "cached_value", jnp.zeros,
-                                     (b, cfg.max_seq_len, kv, hd), cfg.dtype)
+                                     (b, cfg.max_seq_len, kv * hd), cfg.dtype)
             # Per-position document ids, same contract as training: decode
             # queries attend only cache entries with THEIR document id.
             # id 0 marks left-padding (batched serving pads unequal prompts
@@ -297,12 +319,20 @@ class Attention(nn.Module):
             # Append this chunk at the cursor (static-shape cache update) and
             # attend the chunk's queries against the cache prefix: query at
             # absolute position cur+i sees columns <= cur+i.
+            b, sq = x.shape[0], x.shape[1]
+            kv = cfg.resolved_kv_heads
             k_all = jax.lax.dynamic_update_slice(
-                cached_k.value, k.astype(cached_k.value.dtype), (0, cur, 0, 0))
+                cached_k.value,
+                k.reshape(b, sq, kv * hd).astype(cached_k.value.dtype),
+                (0, cur, 0))
             v_all = jax.lax.dynamic_update_slice(
-                cached_v.value, v.astype(cached_v.value.dtype), (0, cur, 0, 0))
+                cached_v.value,
+                v.reshape(b, sq, kv * hd).astype(cached_v.value.dtype),
+                (0, cur, 0))
             cached_k.value, cached_v.value = k_all, v_all
             cache_index.value = cur + sq
+            k_all = k_all.reshape(b, cfg.max_seq_len, kv, hd)
+            v_all = v_all.reshape(b, cfg.max_seq_len, kv, hd)
             col = jnp.arange(cfg.max_seq_len)
             row_pos = cur + jnp.arange(sq)
             base = (col[None, :] <= row_pos[:, None])[None, None]  # [1,1,sq,Smax]
@@ -352,7 +382,7 @@ class Attention(nn.Module):
                               kernel_init=nn.with_logical_partitioning(
                                   default_init(), ("heads", "head_dim", "embed")),
                               name="o_proj")(out)
-        return nn.with_logical_constraint(out, ("batch", "seq", "embed"))
+        return nn.with_logical_constraint(out, ("batch", "seq", "act_embed"))
 
 
 class MLP(nn.Module):
@@ -376,7 +406,7 @@ class MLP(nn.Module):
         h = nn.with_logical_constraint(h, ("batch", "seq", "mlp"))
         out = param_dense(cfg.dim, ("mlp", "embed"), "down_proj", cfg.dtype,
                           use_bias=cfg.activation != "swiglu")(h)
-        return nn.with_logical_constraint(out, ("batch", "seq", "embed"))
+        return nn.with_logical_constraint(out, ("batch", "seq", "act_embed"))
 
 
 class Block(nn.Module):
@@ -393,6 +423,12 @@ class Block(nn.Module):
 
     cfg: TransformerConfig
     mlp_factory: Callable | None = None
+    # attention_fn rides as a module ATTRIBUTE (static), not a call
+    # argument: under nn.remat every call argument is traced, and a
+    # python callable cannot be turned into a tracer — passing e.g. the
+    # shard_map'd mesh attention or a CP ring through a remat'd scanned
+    # stack needs it here (the call kwarg remains for non-remat users).
+    attention_fn: Callable | None = None
 
     @nn.compact
     def __call__(self, x: jax.Array, *,
@@ -403,6 +439,7 @@ class Block(nn.Module):
                  attention_fn: Callable | None = None,
                  decode: bool = False) -> jax.Array:
         cfg = self.cfg
+        attention_fn = attention_fn or self.attention_fn
         h = make_norm(cfg, "attn_norm")(x)
         h = Attention(cfg, name="attn")(h, mask=mask, positions=positions,
                                         segment_ids=segment_ids,
@@ -419,7 +456,7 @@ class Block(nn.Module):
         if cfg.dropout_rate:
             h = nn.Dropout(cfg.dropout_rate, deterministic=deterministic)(h)
         x = x + h
-        return nn.with_logical_constraint(x, ("batch", "seq", "embed"))
+        return nn.with_logical_constraint(x, ("batch", "seq", "act_embed"))
 
 
 class Transformer(nn.Module):
@@ -465,7 +502,7 @@ class Transformer(nn.Module):
                              embedding_init=nn.with_logical_partitioning(
                                  embed_init, (None, "embed")),
                              name="pos_embed")(pos)
-        x = nn.with_logical_constraint(x, ("batch", "seq", "embed"))
+        x = nn.with_logical_constraint(x, ("batch", "seq", "act_embed"))
 
         block_cls = Block
         if cfg.remat and not decode:
@@ -484,22 +521,22 @@ class Transformer(nn.Module):
                 lambda mdl, carry, _: (
                     mdl(carry, mask=mask, positions=positions,
                         segment_ids=segment_ids,
-                        deterministic=deterministic,
-                        attention_fn=attention_fn, **dkw), None),
+                        deterministic=deterministic, **dkw), None),
                 variable_axes={"params": 0, "intermediates": 0, "cache": 0},
                 split_rngs={"params": True, "dropout": True},
                 length=cfg.n_layers,
                 metadata_params={nn.PARTITION_NAME: "layers"},
-            )(block_cls(cfg, mlp_factory=self.mlp_factory, name="blocks"),
+            )(block_cls(cfg, mlp_factory=self.mlp_factory,
+                        attention_fn=attention_fn, name="blocks"),
               x, None)
         else:
             for i in range(cfg.n_layers):
                 x = block_cls(cfg, mlp_factory=self.mlp_factory,
+                              attention_fn=attention_fn,
                               name=f"block_{i}")(
                     x, mask=mask, positions=positions,
                     segment_ids=segment_ids,
-                    deterministic=deterministic, attention_fn=attention_fn,
-                    **dkw)
+                    deterministic=deterministic, **dkw)
         return make_norm(cfg, "final_norm")(x)
 
 

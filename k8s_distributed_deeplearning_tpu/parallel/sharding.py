@@ -42,6 +42,18 @@ DEFAULT_RULES: Rules = (
     ("batch", ("data", "fsdp")),     # DP over data, and over fsdp (ZeRO data axis)
     ("seq", "sequence"),             # activation sequence sharding (CP)
     ("embed", "fsdp"),               # FSDP weight shard axis
+    # Activations name their feature dim "act_embed", NOT "embed": flax
+    # prunes duplicate mesh axes when resolving a constraint, so
+    # ("batch", "seq", "embed") on an fsdp mesh handed fsdp to the embed
+    # dim and silently STRIPPED it from batch — residuals then shard
+    # batch only over "data" and every unsharded-dim tensor (mlp hidden,
+    # attention internals) replicates fsdp-fold-×. Found by the 8B
+    # memory analysis (round 5): together with the shard_map'd attention
+    # (ops.attention.make_mesh_attention_fn) per-layer temp dropped
+    # 4.81 -> 0.81 GB/device on the dp8×fsdp8 virtual v5p-64.
+    # Activations stay unsharded on features (ZeRO shards WEIGHTS, not
+    # activations); batch owns data×fsdp.
+    ("act_embed", None),
     ("embed_out", None),             # square-projection output dim (dedup)
     ("mlp", "tensor"),               # Megatron column-parallel
     ("heads", "tensor"),             # attention-head parallel
