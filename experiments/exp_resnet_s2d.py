@@ -1,0 +1,64 @@
+"""ResNet stem A/B: 7x7/s2 conv vs the space-to-depth transform
+(VERDICT r4 #6 — "attack the bytes"; the round-4 roofline closed the
+question for the current graph, this measures the layout lever it
+skipped). Same harness as bench --suite zoo's ResNet row.
+Run on TPU: python experiments/exp_resnet_s2d.py
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from k8s_distributed_deeplearning_tpu.models import resnet
+from k8s_distributed_deeplearning_tpu.parallel import data_parallel as dp
+from k8s_distributed_deeplearning_tpu.parallel import mesh as mesh_lib
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "examples"))
+import train_zoo  # noqa: E402
+
+B = 128
+mesh = mesh_lib.make_mesh({"data": -1})
+
+
+def rate(stem):
+    model = resnet.resnet50(dtype=jnp.bfloat16, stem=stem)
+    opt = optax.adam(1e-3)
+    variables = model.init(jax.random.key(0), jnp.zeros((1, 224, 224, 3)),
+                           train=False)
+    state = train_zoo.ResNetState(variables["params"],
+                                  variables.get("batch_stats", {}),
+                                  opt.init(variables["params"]),
+                                  jnp.zeros((), jnp.int32))
+    state = jax.device_put(state, jax.sharding.NamedSharding(
+        mesh, jax.sharding.PartitionSpec()))
+    step = train_zoo.make_resnet_step(model, opt, mesh)
+    batch = dp.shard_batch({
+        "image": jax.random.normal(jax.random.key(1), (B, 224, 224, 3),
+                                   jnp.float32),
+        "label": jax.random.randint(jax.random.key(2), (B,), 0, 1000)}, mesh)
+    rng = jax.random.key(3)
+    for _ in range(3):
+        state, loss, _ = step(state, batch, rng)
+    float(loss)
+    runs = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(10):
+            state, loss, _ = step(state, batch, rng)
+        float(loss)
+        runs.append(B * 10 / (time.perf_counter() - t0))
+    return sorted(runs)[1]
+
+
+base = rate("conv7")
+s2d = rate("s2d")
+print(json.dumps({"conv7_img_per_sec": round(base, 1),
+                  "s2d_img_per_sec": round(s2d, 1),
+                  "delta_pct": round(100 * (s2d - base) / base, 2)}))

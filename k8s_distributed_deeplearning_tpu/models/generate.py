@@ -4,10 +4,13 @@ The reference trains and (in the Keras variant) saves/evaluates models
 (``tensorflow_mnist_gpu.py:184-191``) but has no inference path at all; a
 complete LM framework needs one. TPU-first design:
 
-- the KV cache is a fixed ``[B, max_seq_len, kv, head_dim]`` buffer per layer
-  (mutable "cache" collection in :mod:`models.transformer`), updated with
-  ``dynamic_update_slice`` — no growing arrays, so the decode step compiles
-  once and reruns for every token;
+- the KV cache is a fixed ``[B, max_seq_len, kv·head_dim]`` buffer per
+  layer — heads FOLDED into the lane dim so TPU tiling doesn't pad the
+  (kv, head_dim) minors 4× and the per-step update stays an in-place
+  sliver write (round 5; see the decode-branch comment in
+  :mod:`models.transformer`) — held in the mutable "cache" collection and
+  updated with ``dynamic_update_slice``: no growing arrays, so the decode
+  step compiles once and reruns for every token;
 - the whole generate loop is ONE jitted program: prefill over the prompt,
   then ``lax.scan`` over decode steps (token-at-a-time), greedy or
   temperature sampling inside the scan body;
