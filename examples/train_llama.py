@@ -123,6 +123,13 @@ def main(argv: list[str] | None = None) -> dict:
                         "not with --pp or --chunked-ce")
     parser.add_argument("--moe-top-k", type=int, default=2)
     parser.add_argument("--moe-capacity-factor", type=float, default=1.25)
+    parser.add_argument("--moe-dispatch", default="index",
+                        choices=["index", "einsum", "ragged"],
+                        help="expert dispatch: capacity index scatter "
+                        "(default), dense one-hot einsums, or the DROPLESS "
+                        "grouped-GEMM path (ops/pallas_gmm — no capacity, "
+                        "no overflow drops; single-shard expert compute, "
+                        "so not with --ep > 1)")
     parser.add_argument("--ep", type=int, default=1,
                         help="expert-parallel mesh axis (shards the "
                         "'expert' logical axis of MoE weights/buffers)")
@@ -206,9 +213,15 @@ def main(argv: list[str] | None = None) -> dict:
                 "train a dense model — use the sharded-trainer axes "
                 "(--dp/--fsdp/--tp/--sp) for MoE")
         from k8s_distributed_deeplearning_tpu.models import moe as moe_lib
+        if args.moe_dispatch == "ragged" and args.ep > 1:
+            raise ValueError(
+                "--moe-dispatch ragged is single-shard expert compute "
+                "(XLA cannot partition through the grouped-GEMM kernel); "
+                "use --moe-dispatch index with --ep")
         moe_cfg = moe_lib.MoEConfig(
             num_experts=args.moe_experts, top_k=args.moe_top_k,
-            capacity_factor=args.moe_capacity_factor)
+            capacity_factor=args.moe_capacity_factor,
+            dispatch=args.moe_dispatch)
         model = moe_lib.MoELM(model_cfg, moe_cfg)
     else:
         model = llama.LlamaLM(model_cfg)
@@ -231,6 +244,15 @@ def main(argv: list[str] | None = None) -> dict:
             cp_impl, cp_inner = "ring", "xla"
         attention_fn = cp.make_context_parallel_attention(
             mesh, cp_impl, inner_impl=cp_inner)
+    elif not use_pp and any(mesh.shape.get(a, 1) > 1
+                            for a in ("data", "fsdp", "tensor")):
+        # Multi-way GSPMD mesh without CP: shard-map the attention op
+        # over batch (data x fsdp) and heads (tensor). Without this the
+        # Pallas flash call has no partitioning rule and GSPMD REPLICATES
+        # attention on every chip (ops.attention.make_mesh_attention_fn).
+        from k8s_distributed_deeplearning_tpu.ops import attention as att_ops
+        attention_fn = att_ops.make_mesh_attention_fn(
+            mesh, impl=model_cfg.attention_impl)
 
     # Chunked CE defaults on for the 8B preset, where the [B,S,V] logits
     # tensor (V=128256) is the single largest activation in the step —
@@ -283,14 +305,6 @@ def main(argv: list[str] | None = None) -> dict:
         state = trainer.init(init, jax.random.key(conf.seed))
         step_fn = trainer.make_step(donate=True, microbatches=conf.grad_accum)
 
-    tokens = data_lib.load_tokens(args.data_path,
-                                  vocab_size=model_cfg.vocab_size,
-                                  seed=conf.seed)
-    # Hold out the corpus tail for eval — disjoint from every training epoch
-    # (each epoch permutes the SAME training windows, so "future step indices"
-    # are not held out).
-    n_eval = max(2 * (seq_len + 1), int(0.05 * len(tokens)))
-    eval_tokens, tokens = tokens[-n_eval:], tokens[:-n_eval]
     # Per-host batch: the global batch split across processes (each host
     # contributes its local slice; shard_batch assembles the global array).
     # Checked BEFORE metrics/checkpointer construction so a config error
@@ -301,21 +315,53 @@ def main(argv: list[str] | None = None) -> dict:
             f"--batch-size {global_batch} (global) must divide evenly across "
             f"{topo.num_processes} processes")
     per_host = global_batch // topo.num_processes
-    if args.pack:
-        docs = data_lib.split_documents(tokens, args.pack_sep_id,
-                                        seed=conf.seed)
-        batcher = data_lib.PackedTokenBatcher(
-            docs, per_host, seq_len, seed=conf.seed,
+
+    streaming = bool(args.data_path) and os.path.isdir(args.data_path)
+    if streaming:
+        # Directory of pre-tokenized shards: the large-corpus streaming
+        # path (memory-mapped, resident = touched pages). Packing needs
+        # whole documents in memory — point --pack at a file instead.
+        if args.pack:
+            raise ValueError(
+                "--pack needs an in-memory corpus (document packing is a "
+                "whole-corpus host pass): pass --data-path FILE, not a "
+                "shard directory")
+        probe = data_lib.TokenShardBatcher(
+            args.data_path, per_host, seq_len, seed=conf.seed)
+        n_eval = max(2 * (seq_len + 1),
+                     min(probe.final_shard_tokens // 10, 64 * seq_len))
+        batcher = data_lib.TokenShardBatcher(
+            args.data_path, per_host, seq_len, seed=conf.seed,
             process_index=topo.process_index,
-            num_processes=topo.num_processes)
-        metrics_extra = {"packing_efficiency":
-                         round(batcher.packing_efficiency, 4)}
+            num_processes=topo.num_processes,
+            hold_out_tail=n_eval)
+        eval_tokens = batcher.tail_tokens()
+        metrics_extra = {"data": "sharded-streaming",
+                         "num_windows": batcher.num_windows}
     else:
-        batcher = data_lib.TokenBatcher(tokens, per_host, seq_len,
-                                        seed=conf.seed,
-                                        process_index=topo.process_index,
-                                        num_processes=topo.num_processes)
-        metrics_extra = {}
+        tokens = data_lib.load_tokens(args.data_path,
+                                      vocab_size=model_cfg.vocab_size,
+                                      seed=conf.seed)
+        # Hold out the corpus tail for eval — disjoint from every training
+        # epoch (each epoch permutes the SAME training windows, so "future
+        # step indices" are not held out).
+        n_eval = max(2 * (seq_len + 1), int(0.05 * len(tokens)))
+        eval_tokens, tokens = tokens[-n_eval:], tokens[:-n_eval]
+        if args.pack:
+            docs = data_lib.split_documents(tokens, args.pack_sep_id,
+                                            seed=conf.seed)
+            batcher = data_lib.PackedTokenBatcher(
+                docs, per_host, seq_len, seed=conf.seed,
+                process_index=topo.process_index,
+                num_processes=topo.num_processes)
+            metrics_extra = {"packing_efficiency":
+                             round(batcher.packing_efficiency, 4)}
+        else:
+            batcher = data_lib.TokenBatcher(tokens, per_host, seq_len,
+                                            seed=conf.seed,
+                                            process_index=topo.process_index,
+                                            num_processes=topo.num_processes)
+            metrics_extra = {}
 
     if conf.keep_best and not conf.eval_every:
         raise ValueError("--keep-best needs --eval-every N (best-by-metric "

@@ -202,5 +202,46 @@ def run_accuracy_gate(data_dir: str, checkpoint_dir: str,
     return acc
 
 
+def run_digits_gate(checkpoint_dir: str, steps: int | None = None,
+                    threshold: float = 0.97) -> float:
+    """Real-data convergence gate that EXECUTES in zero-egress
+    environments: the UCI hand-written digits bundled with scikit-learn
+    (real scanned digits — see ``data.make_digits_fixture``), through the
+    IDENTICAL pipeline the ≥99% MNIST gate drives (idx files on disk →
+    ``--data-dir`` → ShardedBatcher → DP engine → full held-out split
+    eval). The reference's deployed hyperparameters (batch 100, Adam
+    1e-3 × world). This is NOT the MNIST north star — that gate stays
+    honestly "skipped" without the canonical idx files — it is the
+    executed proof that the training engine converges on real data.
+    Returns the measured accuracy; asserts ≥ *threshold* (0.97 — the
+    ConvNet clears it with margin; kNN baselines on this set sit ~0.98).
+    """
+    if steps is None:
+        steps = int(os.environ.get("DIGITS_STEPS", "1500"))
+    if os.path.isdir(checkpoint_dir) and os.listdir(checkpoint_dir):
+        raise ValueError(
+            f"checkpoint_dir {checkpoint_dir!r} is non-empty: the gate "
+            "would resume a finished run instead of training")
+    import tempfile
+
+    from k8s_distributed_deeplearning_tpu.train import data as data_lib
+    fixture = data_lib.make_digits_fixture(
+        tempfile.mkdtemp(prefix="digits_fixture_"))
+    result = main([
+        "--data-dir", fixture,
+        "--num-steps", str(steps),
+        "--batch-size", "100",
+        "--lr", "0.001",
+        "--checkpoint-dir", checkpoint_dir,
+        "--log-every", "500",
+    ])
+    assert result.get("eval_examples") == 400, (
+        "gate must cover the full held-out split", result)
+    acc = float(result["accuracy"])
+    assert acc >= threshold, (
+        f"real-digits convergence gate FAILED: {acc:.4f} < {threshold}")
+    return acc
+
+
 if __name__ == "__main__":
     main(sys.argv[1:])
