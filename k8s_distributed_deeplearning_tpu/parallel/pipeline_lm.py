@@ -329,18 +329,21 @@ class PipelineTrainer:
                     shape = (leaf.shape[0] * leaf.shape[1] * leaf.shape[2],
                              *leaf.shape[3:])
                     if isinstance(leaf, jax.ShapeDtypeStruct):
-                        # The chunk-dim sharding (P sharded on dim 1) has
-                        # no equivalent on the merged natural dim (q-major
-                        # element order), so an abstract template can't
-                        # carry a faithful target sharding — demand the
-                        # concrete state (what loop.fit and the trainers
-                        # pass) instead of restoring unsharded and
-                        # spiking HBM on large models.
-                        raise NotImplementedError(
-                            "interleaved-schedule portable restore needs "
-                            "the concrete TrainState as the template, not "
-                            "ShapeDtypeStructs (block leaf at "
-                            f"{jax.tree_util.keystr(path)})")
+                        # Abstract (cold-start) template: the CHUNK-dim
+                        # sharding (P on dim 1 of [V, P, nl, ...]) has no
+                        # NamedSharding equivalent on the merged natural
+                        # dim (device ownership is periodic, not
+                        # contiguous). But a CONTIGUOUS dim-0 split IS
+                        # expressible and equally bounded: restore the
+                        # natural [L, ...] array sharded L/P-per-device,
+                        # then from_portable's jitted reshape emits the
+                        # all-to-all into the true chunk layout — no leaf
+                        # is ever replicated (round 5; closes the r4
+                        # NotImplementedError at this site).
+                        return jax.ShapeDtypeStruct(
+                            shape, leaf.dtype,
+                            sharding=NamedSharding(
+                                self.mesh, P(self.axis_name)))
                     return leaf.reshape(shape)
                 return leaf
             return jax.tree_util.tree_map_with_path(one, tree)
@@ -359,10 +362,16 @@ class PipelineTrainer:
                     nl = leaf.shape[0] // (v * p)
                     return leaf.reshape(v, p, nl, *leaf.shape[1:])
                 return leaf
-            out = jax.tree_util.tree_map_with_path(one, tree)
             if getattr(self, "_state_sh", None) is not None:
-                out = jax.device_put(out, self._state_sh)
-            return out
+                # Jitted reshape with explicit out_shardings: the natural
+                # contiguous dim-0 shards redistribute to the chunk layout
+                # via XLA collectives, per-leaf bounded memory — an eager
+                # reshape here would all-gather every block leaf (the
+                # merged-dim ownership is periodic, see to_portable).
+                return jax.jit(
+                    lambda t: jax.tree_util.tree_map_with_path(one, t),
+                    out_shardings=self._state_sh)(tree)
+            return jax.tree_util.tree_map_with_path(one, tree)
 
         return to_portable, from_portable
 
@@ -373,10 +382,7 @@ class PipelineTrainer:
             return NamedSharding(self.mesh, spec)
         return jax.tree_util.tree_map_with_path(one, abstract_state)
 
-    def init(self, init_params_fn: Callable[[jax.Array], PyTree],
-             rng: jax.Array) -> TrainState:
-        """Sharded-at-birth: block weights land on their stage, the rest
-        replicates (same jit-out-shardings pattern as ShardedTrainer)."""
+    def _make_state_fn(self, init_params_fn):
         import flax.linen as nn
 
         def make_state(r):
@@ -386,7 +392,30 @@ class PipelineTrainer:
             return TrainState(params=params,
                               opt_state=self.optimizer.init(params),
                               step=jnp.zeros((), jnp.int32))
+        return make_state
 
+    def abstract_state(self, init_params_fn: Callable[[jax.Array], PyTree],
+                       rng: jax.Array) -> TrainState:
+        """ShapeDtypeStruct TrainState with target shardings attached —
+        the cold-start restore template: pass to ``Checkpointer
+        .restore_latest`` to restore a checkpoint into this trainer
+        WITHOUT materializing an initial state first (no init compute, no
+        double allocation). Works for every schedule including
+        interleaved (the portable transforms restore natural blocks
+        contiguously sharded, then all-to-all into the chunk layout —
+        see ``portable_transforms``). Also primes the shardings
+        ``from_portable`` redistributes into."""
+        abstract = jax.eval_shape(self._make_state_fn(init_params_fn), rng)
+        self._state_sh = self.state_shardings(abstract)
+        return jax.tree_util.tree_map(
+            lambda l, s: jax.ShapeDtypeStruct(l.shape, l.dtype, sharding=s),
+            abstract, self._state_sh)
+
+    def init(self, init_params_fn: Callable[[jax.Array], PyTree],
+             rng: jax.Array) -> TrainState:
+        """Sharded-at-birth: block weights land on their stage, the rest
+        replicates (same jit-out-shardings pattern as ShardedTrainer)."""
+        make_state = self._make_state_fn(init_params_fn)
         abstract = jax.eval_shape(make_state, rng)
         self._state_sh = self.state_shardings(abstract)
         return jax.jit(make_state, out_shardings=self._state_sh)(rng)

@@ -621,3 +621,59 @@ def test_interleaved_deep_virtual_matches_gpipe():
     jax.tree.map(
         lambda a, b: np.testing.assert_allclose(a, b, rtol=5e-4, atol=1e-5),
         tr_i._natural_blocks(g_i), g_g)
+
+
+def test_interleaved_restore_from_abstract_template(tmp_path):
+    """Cold-start restore INTO the interleaved schedule from
+    ShapeDtypeStruct templates (no init materialization) — the r4
+    NotImplementedError at the portable-transform site, closed: the
+    natural blocks restore contiguously sharded on the pipeline axis and
+    redistribute into the chunk layout via the jitted reshape. Matrix
+    direction that was missing: interleaved-as-target, abstract source."""
+    from k8s_distributed_deeplearning_tpu.train.checkpoint import Checkpointer
+    import flax.linen as nn
+
+    cfg = _cfg(n_layers=8)
+    model = llama.LlamaLM(cfg)
+    mesh = mesh_lib.make_mesh({"pipeline": 4, "data": 2})
+    init = lambda r: model.init(r, jnp.zeros((1, 8), jnp.int32))["params"]
+
+    # Write under 1f1b (natural layout on disk).
+    tr_f = pipeline_lm.PipelineTrainer(model, optax.adam(1e-3), mesh,
+                                       num_microbatches=4, schedule="1f1b")
+    st_f = tr_f.init(init, jax.random.key(0))
+    d = str(tmp_path / "ck")
+    ck_w = Checkpointer(d, portable_transforms=tr_f.portable_transforms())
+    ck_w.save(5, st_f, force=True)
+    ck_w.close()
+
+    # Cold-start: abstract template, never a concrete init.
+    tr_i = pipeline_lm.PipelineTrainer(model, optax.adam(1e-3), mesh,
+                                       num_microbatches=4,
+                                       schedule="interleaved", num_virtual=2)
+    template = tr_i.abstract_state(init, jax.random.key(0))
+    assert all(isinstance(l, jax.ShapeDtypeStruct)
+               for l in jax.tree_util.tree_leaves(template))
+    ck_r = Checkpointer(d, portable_transforms=tr_i.portable_transforms())
+    restored, step = ck_r.restore_latest(template)
+    ck_r.close()
+    assert step == 5
+
+    # Values equal the 1f1b params viewed naturally...
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a),
+                                                   np.asarray(b)),
+        tr_i._natural_blocks(nn.meta.unbox(restored.params)),
+        nn.meta.unbox(st_f.params))
+    # ...with the trainer's true chunk shardings (not replicated).
+    ref = tr_i.init(init, jax.random.key(1))
+    for (pa, a), (_, b) in zip(
+            jax.tree_util.tree_flatten_with_path(restored.params)[0],
+            jax.tree_util.tree_flatten_with_path(ref.params)[0]):
+        av, bv = nn.meta.unbox(a), nn.meta.unbox(b)
+        if hasattr(av, "sharding"):
+            assert av.sharding == bv.sharding, jax.tree_util.keystr(pa)
+    # And it steps.
+    st2, loss, _ = tr_i.make_step(donate=False)(
+        restored, tr_i.shard_batch(_batch()), None)
+    assert np.isfinite(float(loss))
