@@ -344,7 +344,15 @@ class PipelineTrainer:
                             shape, leaf.dtype,
                             sharding=NamedSharding(
                                 self.mesh, P(self.axis_name)))
-                    return leaf.reshape(shape)
+                    # Concrete leaf (save path): merge under jit with a
+                    # contiguous dim-0 out-sharding — an EAGER reshape
+                    # would all-gather the leaf on every device (the
+                    # merged dim's chunk ownership is periodic, see
+                    # from_portable), spiking HBM on every save.
+                    return jax.jit(
+                        lambda a, _s=shape: a.reshape(_s),
+                        out_shardings=NamedSharding(
+                            self.mesh, P(self.axis_name)))(leaf)
                 return leaf
             return jax.tree_util.tree_map_with_path(one, tree)
 

@@ -138,15 +138,21 @@ class Checkpointer:
                       abstract_state: PyTree) -> tuple[PyTree, int] | None:
         if step is None:
             return None
-        if self._to_portable is not None:
-            # The on-disk layout is the portable one: build the restore
-            # template in that layout, then map back to the trainer's.
-            abstract_state = self._to_portable(abstract_state)
-        ref = jax.tree.map(
+        # Abstract-ify BEFORE the portable transform: a concrete template
+        # (the restore-on-start path passes the live state) would make
+        # to_portable compute real layout reshapes whose values are
+        # immediately discarded — on the interleaved pipeline that is a
+        # device round-trip per block leaf for nothing.
+        abstract_state = jax.tree.map(
             lambda x: x if isinstance(x, jax.ShapeDtypeStruct)
             else jax.ShapeDtypeStruct(jax.numpy.shape(x), x.dtype,
                                       sharding=getattr(x, "sharding", None)),
             abstract_state)
+        if self._to_portable is not None:
+            # The on-disk layout is the portable one: build the restore
+            # template in that layout, then map back to the trainer's.
+            abstract_state = self._to_portable(abstract_state)
+        ref = abstract_state
         state = self._mgr.restore(step, args=ocp.args.StandardRestore(ref))
         if self._from_portable is not None:
             state = self._from_portable(state)

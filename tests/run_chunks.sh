@@ -26,7 +26,7 @@ FAST=0
 CHUNK_TIMEOUT="${CHUNK_TIMEOUT:-900}"
 declare -A CHUNKS
 CHUNKS[core]="tests/test_model_mnist.py tests/test_model_zoo.py tests/test_transformer.py tests/test_pallas_flash.py tests/test_pallas_gmm.py tests/test_bench_gate.py"
-CHUNKS[parallel1]="tests/test_collectives.py tests/test_data_parallel.py tests/test_sharding.py tests/test_8b_scale.py"
+CHUNKS[parallel1]="tests/test_collectives.py tests/test_data_parallel.py tests/test_sharding.py tests/test_8b_scale.py tests/test_mesh_attention.py"
 CHUNKS[parallel2]="tests/test_context_parallel.py tests/test_pipeline.py tests/test_pipeline_lm.py"
 # MoE grew its own chunk in round 5 (ragged grouped-GEMM dispatch tests):
 # bundled with parallel2 the pair overran the chunk timeout.
@@ -36,9 +36,20 @@ CHUNKS[llama]="tests/test_train_llama.py tests/test_generate.py"
 CHUNKS[deploy]="tests/test_watch.py tests/test_render.py tests/test_deploy_smoke.py tests/test_elastic.py tests/test_preemption.py tests/test_cluster_e2e.py"
 CHUNKS[slow1]="tests/test_train_e2e.py tests/test_multiprocess.py"
 CHUNKS[slow2]="tests/test_multihost_train.py tests/test_multihost_llama.py tests/test_train_zoo.py"
-ORDER=(core parallel1 parallel2 train llama deploy slow1 slow2)
+ORDER=(core parallel1 parallel2 moe train llama deploy slow1 slow2)
 
 # --- completeness check: every tests/test_*.py in EXACTLY one chunk ------
+# ...and every declared chunk actually in ORDER: a chunk missing from the
+# run order would exit green while silently never executing its files
+# (caught by review in round 5 — the freshly-split moe chunk did exactly
+# that for one run).
+for name in "${!CHUNKS[@]}"; do
+    case " ${ORDER[*]} " in
+        *" $name "*) ;;
+        *) echo "run_chunks.sh: chunk '$name' declared but not in ORDER" >&2
+           exit 3;;
+    esac
+done
 listed=$(echo "${CHUNKS[@]}" | tr ' ' '\n' | sort)
 actual=$(ls tests/test_*.py | sort)
 missing=$(comm -23 <(echo "$actual") <(echo "$listed"))
