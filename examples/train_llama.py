@@ -119,8 +119,8 @@ def main(argv: list[str] | None = None) -> dict:
     parser.add_argument("--moe-experts", type=int, default=0,
                         help="swap every MLP for a mixture-of-experts layer "
                         "with N experts (models/moe.py MoELM; 0 = dense). "
-                        "Composes with --pack/--sp/--fsdp/--tp/--ep; "
-                        "not with --pp or --chunked-ce")
+                        "Composes with --pack/--sp/--fsdp/--tp/--ep/"
+                        "--chunked-ce; not with --pp")
     parser.add_argument("--moe-top-k", type=int, default=2)
     parser.add_argument("--moe-capacity-factor", type=float, default=1.25)
     parser.add_argument("--moe-dispatch", default="index",
@@ -255,9 +255,9 @@ def main(argv: list[str] | None = None) -> dict:
             mesh, impl=model_cfg.attention_impl)
 
     # Chunked CE defaults on for the 8B preset, where the [B,S,V] logits
-    # tensor (V=128256) is the single largest activation in the step —
-    # except for MoE runs (MoELM has no chunked-head path), where the
-    # default stays off and only an EXPLICIT --chunked-ce errors.
+    # tensor (V=128256) is the single largest activation in the step.
+    # MoE runs compose with it since round 5 (moe.loss_fn chunked=True);
+    # their default stays off (32k-vocab presets gain nothing, BENCHMARKS).
     chunked = (args.chunked_ce if args.chunked_ce is not None
                else (args.preset == "8b" and not args.moe_experts))
 
@@ -287,15 +287,11 @@ def main(argv: list[str] | None = None) -> dict:
                              "instead (the pipeline already microbatches)")
     else:
         if moe_cfg is not None:
-            if chunked:
-                raise ValueError(
-                    "--chunked-ce is not supported with --moe-experts "
-                    "(MoELM has no chunked-head path); drop one of them")
-
             def loss(params, batch, rng):
                 # moe_lib bound where moe_cfg was built (same function).
                 return moe_lib.loss_fn(model, moe_cfg, params, batch, rng,
-                                       attention_fn=attention_fn)
+                                       attention_fn=attention_fn,
+                                       chunked=chunked)
         else:
             def loss(params, batch, rng):
                 return llama.loss_fn(model, params, batch, rng,

@@ -300,6 +300,23 @@ class MoEMLP(nn.Module):
             return nn.with_logical_constraint(ye, ("expert", None, "embed"))
 
         if decode:
+            if moe.dispatch == "ragged" and t >= 128:
+                # Ragged serving for WIDE calls (prefill): dropless and
+                # width-independent like the capacity=T path below but
+                # without its [E, T, d] buffers — prefill MLP work stays
+                # at top_k slots/token instead of E× (parity-tested
+                # alongside the index serving path). Narrow calls (the
+                # per-token decode steps, t = B) stay on the index path:
+                # both serve IDENTICAL per-token top-k routing, so
+                # switching by call width changes nothing semantically,
+                # and at t=8 the grouped-GEMM grid overhead measured
+                # slower than the tiny dropless einsums (3.8k vs 4.2k
+                # tok/s end-to-end) while ragged prefill does ~E/k×
+                # less MLP work. Single-shard expert compute, like
+                # ragged training.
+                y, _ = self._ragged_dispatch(tokens, logits,
+                                             w_gate, w_up, w_down)
+                return y.reshape(b, s, d)
             # Serving path: DROPLESS top-k via the index dispatch with
             # capacity = T (no token can overflow a T-deep buffer, so
             # every token keeps all k choices). The training paths size
@@ -413,8 +430,14 @@ class MoEMLP(nn.Module):
         probs, idx_list, assign, gate_stack = _topk_assignments(logits, k)
         counts = functools.reduce(
             lambda a, b: a + b, (jnp.sum(a, axis=0) for a in assign))
+        # Row block clipped to the call width: at decode steps (t = B)
+        # the configured 512 block would pad 16 real rows to 4.6k (one
+        # mostly-dead block per expert) and measure 2.2x SLOWER than the
+        # capacity path; a t*k-sized block keeps m_pad ~ (E+1)*t*k.
+        bm = min(moe.ragged_block_m,
+                 max(8, 1 << (t * k - 1).bit_length()))
         layout = pallas_gmm.grouped_layout(
-            counts.astype(jnp.int32), t * k, block_m=moe.ragged_block_m)
+            counts.astype(jnp.int32), t * k, block_m=bm)
 
         used = jnp.zeros((moe.num_experts,), jnp.float32)
         dests = []
@@ -484,7 +507,7 @@ class MoELM(nn.Module):
     @nn.compact
     def __call__(self, tokens, *, positions=None, segment_ids=None,
                  attention_fn=None, deterministic: bool = True,
-                 decode: bool = False):
+                 decode: bool = False, return_hidden: bool = False):
         if self.moe.routing == "expert_choice":
             warnings.warn(
                 "expert_choice routing inside a causal LM is non-causal: "
@@ -498,6 +521,11 @@ class MoELM(nn.Module):
             tokens, positions=positions, segment_ids=segment_ids,
             deterministic=deterministic,
             attention_fn=attention_fn, decode=decode)
+        if return_hidden:
+            # Final hidden states for the chunked LM-head loss (same
+            # contract as LlamaLM.return_hidden): apply-time only — init
+            # takes the default path so LMHead params get created.
+            return x
         return LMHead(self.cfg, name="head")(x)
 
 
@@ -533,7 +561,8 @@ def flops_per_token(cfg: TransformerConfig, moe: MoEConfig, *,
 
 
 def loss_fn(model: MoELM, moe: MoEConfig, params, batch, rng=None, *,
-            attention_fn=None):
+            attention_fn=None, chunked: bool = False,
+            chunk_size: int = 1024):
     """Next-token CE + load-balance and router-z auxiliary losses.
 
     ``batch``: {"tokens": [B,S] int32, optional "mask": [B,S] 1.0 = count
@@ -543,16 +572,36 @@ def loss_fn(model: MoELM, moe: MoEConfig, params, batch, rng=None, *,
     per-document RoPE restarts, cross-document boundary pairs out of the
     loss). Note the routing itself is per-token but capacity contention is
     batch-global, so packing changes WHICH tokens drop under pressure —
-    the same property any batch composition has for MoE."""
+    the same property any batch composition has for MoE.
+
+    ``chunked=True`` is the same long-vocab memory lever as
+    ``llama.loss_fn``: hidden states come back via ``return_hidden``
+    (aux-loss sows still collected) and the LM-head matmul + CE run per
+    sequence chunk, so ``[B, S, V]`` logits never materialize."""
     inputs, targets, seg_in, positions, mask = lm_batch_views(batch)
     rngs = {"dropout": rng} if rng is not None else None
-    logits, state = model.apply(
-        {"params": params}, inputs, segment_ids=seg_in, positions=positions,
-        deterministic=rng is None, rngs=rngs, attention_fn=attention_fn,
-        mutable=["intermediates"])
-    ce_tok = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
+    apply_kw = dict(segment_ids=seg_in, positions=positions,
+                    deterministic=rng is None, rngs=rngs,
+                    attention_fn=attention_fn, mutable=["intermediates"])
     denom = jnp.maximum(mask.sum(), 1.0)
-    ce = (ce_tok * mask).sum() / denom
+
+    if chunked:
+        from k8s_distributed_deeplearning_tpu.models.llama import unembedding
+        from k8s_distributed_deeplearning_tpu.ops.chunked_ce import (
+            chunked_softmax_cross_entropy)
+        hidden, state = model.apply({"params": params}, inputs,
+                                    return_hidden=True, **apply_kw)
+        w, layout = unembedding(model.cfg, params)
+        ce, acc = chunked_softmax_cross_entropy(
+            hidden, w, targets, mask, chunk_size=chunk_size,
+            w_layout=layout)
+    else:
+        logits, state = model.apply({"params": params}, inputs, **apply_kw)
+        ce_tok = optax.softmax_cross_entropy_with_integer_labels(logits,
+                                                                 targets)
+        ce = (ce_tok * mask).sum() / denom
+        acc = ((logits.argmax(-1) == targets) * mask).sum() / denom
+
     flat = jax.tree_util.tree_flatten_with_path(state["intermediates"])[0]
     lb = [v for path, v in flat if "load_balance_loss" in str(path)]
     zs = [v for path, v in flat if "router_z_loss" in str(path)]
@@ -562,5 +611,4 @@ def loss_fn(model: MoELM, moe: MoEConfig, params, batch, rng=None, *,
     aux_loss = (moe.aux_loss_weight * sum(jnp.sum(l) for l in lb)
                 + moe.router_z_weight * sum(jnp.sum(z) for z in zs))
     loss = ce + aux_loss
-    acc = ((logits.argmax(-1) == targets) * mask).sum() / denom
     return loss, {"ce": ce, "aux_loss": aux_loss, "accuracy": acc}

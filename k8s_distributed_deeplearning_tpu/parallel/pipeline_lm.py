@@ -323,6 +323,8 @@ class PipelineTrainer:
             return any(getattr(k, "key", getattr(k, "name", None)) == "blocks"
                        for k in path)
 
+        merge_cache: dict = {}   # target shape -> jitted sharded reshape
+
         def to_portable(tree):
             def one(path, leaf):
                 if in_blocks(path) and getattr(leaf, "ndim", 0) >= 3:
@@ -348,11 +350,17 @@ class PipelineTrainer:
                     # contiguous dim-0 out-sharding — an EAGER reshape
                     # would all-gather the leaf on every device (the
                     # merged dim's chunk ownership is periodic, see
-                    # from_portable), spiking HBM on every save.
-                    return jax.jit(
-                        lambda a, _s=shape: a.reshape(_s),
-                        out_shardings=NamedSharding(
-                            self.mesh, P(self.axis_name)))(leaf)
+                    # from_portable), spiking HBM on every save. The
+                    # jitted program is cached per target shape: jit
+                    # keys on function identity, so a fresh lambda per
+                    # leaf per save would re-trace every time.
+                    fn = merge_cache.get(shape)
+                    if fn is None:
+                        fn = merge_cache[shape] = jax.jit(
+                            lambda a, _s=shape: a.reshape(_s),
+                            out_shardings=NamedSharding(
+                                self.mesh, P(self.axis_name)))
+                    return fn(leaf)
                 return leaf
             return jax.tree_util.tree_map_with_path(one, tree)
 

@@ -326,31 +326,41 @@ def test_expert_choice_capacity_exceeding_tokens_clamps():
 
 # --- MoE x decode / packed (late round 4: MoELM gains the full LM surface) --
 
-@pytest.mark.parametrize("routing", ["topk", "expert_choice"])
-def test_moe_incremental_decode_matches_one_shot_prefill(routing):
+@pytest.mark.parametrize("routing,dispatch", [
+    ("topk", "index"), ("expert_choice", "index"), ("topk", "ragged")])
+def test_moe_incremental_decode_matches_one_shot_prefill(routing, dispatch):
     """KV-cache decode on an MoE LM: feeding the prompt token-by-token must
-    reproduce the one-shot prefill logits. The MoE layers use the DROPLESS
+    reproduce the one-shot prefill logits. The MoE layers use a DROPLESS
     per-token path at decode (capacity buffers are sized per call, so the
     capacity paths would route a 1-token step differently than a prefill —
-    the dropless path is width-independent by construction). Expert-choice
-    models decode through the same forced per-token top-k gates (EC's
-    whole-batch selection has no causal decode semantics), so the parity
-    holds for both routings."""
-    cfg = llama.config_tiny(dtype=jnp.float32, max_seq_len=32)
+    the dropless paths are width-independent by construction): capacity=T
+    index buffers by default, the grouped-GEMM ragged path when
+    dispatch="ragged" (no [E, T, d] buffers — prefill MLP work stays at
+    top_k slots/token). Expert-choice models decode through the same
+    forced per-token top-k gates (EC's whole-batch selection has no
+    causal decode semantics), so the parity holds for both routings.
+    The ragged case uses a WIDE prompt so its prefill actually crosses
+    the >=128-token width threshold (ragged grouped-GEMM prefill, index
+    decode steps) — the exact hybrid the serving path runs."""
+    seq = 80 if dispatch == "ragged" else 10
+    prefill = 70 if dispatch == "ragged" else 4
+    cfg = llama.config_tiny(dtype=jnp.float32, max_seq_len=128)
     mcfg = moe.MoEConfig(num_experts=4, top_k=2, capacity_factor=2.0,
-                         routing=routing)
+                         routing=routing, dispatch=dispatch,
+                         ragged_block_m=8)
     model = moe.MoELM(cfg, mcfg)
-    toks = jax.random.randint(jax.random.key(0), (2, 10), 0, cfg.vocab_size)
+    toks = jax.random.randint(jax.random.key(0), (2, seq), 0, cfg.vocab_size)
     params = model.init(jax.random.key(1), toks)["params"]
 
     full, _ = model.apply({"params": params}, toks, decode=True,
                           mutable=["cache"])
-    logits, vars_ = model.apply({"params": params}, toks[:, :4], decode=True,
-                                mutable=["cache"])
-    np.testing.assert_allclose(np.asarray(logits), np.asarray(full[:, :4]),
+    logits, vars_ = model.apply({"params": params}, toks[:, :prefill],
+                                decode=True, mutable=["cache"])
+    np.testing.assert_allclose(np.asarray(logits),
+                               np.asarray(full[:, :prefill]),
                                atol=2e-5, rtol=2e-5)
     cache = vars_["cache"]
-    for i in range(4, toks.shape[1]):
+    for i in range(prefill, toks.shape[1]):
         logits, vars_ = model.apply({"params": params, "cache": cache},
                                     toks[:, i:i + 1], decode=True,
                                     mutable=["cache"])
@@ -496,3 +506,33 @@ def test_ragged_trains_end_to_end(mesh8):
         state, loss, _ = step(state, batch, jax.random.key(i))
         losses.append(float(loss))
     assert losses[-1] < losses[0]
+
+
+def test_moe_chunked_ce_matches_unchunked():
+    """MoE × chunked CE (round 5 — the former NotImplemented combo):
+    hidden-states head chunking must reproduce the unchunked loss AND
+    grads exactly at f32, with the aux losses still collected."""
+    cfg = llama.config_tiny(dtype=jnp.float32, n_layers=2, scan_layers=False)
+    mcfg = moe.MoEConfig(num_experts=4, top_k=2, capacity_factor=2.0)
+    model = moe.MoELM(cfg, mcfg)
+    toks = jax.random.randint(jax.random.key(3), (4, 33), 0, cfg.vocab_size)
+    params = model.init(jax.random.key(1), toks[:, :8])["params"]
+    batch = {"tokens": toks}
+
+    l_ref, aux_ref = moe.loss_fn(model, mcfg, params, batch)
+    l_ch, aux_ch = moe.loss_fn(model, mcfg, params, batch, chunked=True,
+                               chunk_size=8)
+    np.testing.assert_allclose(float(l_ch), float(l_ref), rtol=1e-6)
+    np.testing.assert_allclose(float(aux_ch["aux_loss"]),
+                               float(aux_ref["aux_loss"]), rtol=1e-6)
+    g_ref = jax.grad(lambda p: moe.loss_fn(model, mcfg, p, batch)[0])(params)
+    g_ch = jax.grad(lambda p: moe.loss_fn(model, mcfg, p, batch,
+                                          chunked=True, chunk_size=8)[0])(
+        params)
+    for (ks_, a), (_, b) in zip(
+            sorted(jax.tree_util.tree_flatten_with_path(g_ref)[0],
+                   key=lambda kv: str(kv[0])),
+            sorted(jax.tree_util.tree_flatten_with_path(g_ch)[0],
+                   key=lambda kv: str(kv[0]))):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-5, atol=1e-6, err_msg=str(ks_))

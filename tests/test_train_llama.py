@@ -208,10 +208,15 @@ def test_train_llama_moe_flag_conflicts():
         train_llama.main([
             "--preset", "tiny", "--pp", "2", "--dp", "4",
             "--moe-experts", "4", "--num-steps", "2"])
-    with pytest.raises(ValueError, match="chunked-ce is not supported"):
+    # --chunked-ce × --moe-experts became a WORKING path in round 5
+    # (moe.loss_fn chunked=True; covered by
+    # test_train_llama_moe_chunked_ce_cli) — the remaining exclusive
+    # combo is ragged dispatch × expert parallelism.
+    with pytest.raises(ValueError, match="single-shard"):
         train_llama.main([
-            "--preset", "tiny", "--dp", "8", "--moe-experts", "4",
-            "--chunked-ce", "--num-steps", "2"])
+            "--preset", "tiny", "--dp", "4", "--ep", "2",
+            "--moe-experts", "4", "--moe-dispatch", "ragged",
+            "--num-steps", "2"])
 
 
 def test_train_llama_real_text_corpus_loss_decreases(tmp_path):
@@ -266,3 +271,15 @@ def test_pack_rejects_shard_directory(tmp_path):
             "--seq-len", "64", "--pack", "--data-path", str(shards),
             "--checkpoint-dir", str(tmp_path / "ck"),
         ])
+
+
+def test_train_llama_moe_chunked_ce_cli(tmp_path):
+    """MoE × chunked CE through the CLI — the former NotImplemented combo
+    (round 5): trains and evaluates with finite, sane loss."""
+    import train_llama
+    result = train_llama.main([
+        "--preset", "tiny", "--num-steps", "8", "--batch-size", "8",
+        "--seq-len", "64", "--moe-experts", "4", "--chunked-ce",
+        "--log-every", "4", "--checkpoint-dir", str(tmp_path / "ck"),
+    ])
+    assert np.isfinite(result["eval_loss"])
