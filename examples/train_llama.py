@@ -128,8 +128,9 @@ def main(argv: list[str] | None = None) -> dict:
                         help="expert dispatch: capacity index scatter "
                         "(default), dense one-hot einsums, or the DROPLESS "
                         "grouped-GEMM path (ops/pallas_gmm — no capacity, "
-                        "no overflow drops; single-shard expert compute, "
-                        "so not with --ep > 1)")
+                        "no overflow drops; batch-shard_map'd over "
+                        "data/fsdp, but the expert axis stays index-only: "
+                        "not with --ep > 1)")
     parser.add_argument("--ep", type=int, default=1,
                         help="expert-parallel mesh axis (shards the "
                         "'expert' logical axis of MoE weights/buffers)")
@@ -222,7 +223,11 @@ def main(argv: list[str] | None = None) -> dict:
             num_experts=args.moe_experts, top_k=args.moe_top_k,
             capacity_factor=args.moe_capacity_factor,
             dispatch=args.moe_dispatch)
-        model = moe_lib.MoELM(model_cfg, moe_cfg)
+        # shard_mesh: the ragged grouped-GEMM shard_maps over the batch
+        # axes (a Pallas call has no GSPMD rule — unwrapped it would run
+        # replicated on every device); no-op for the other dispatches.
+        model = moe_lib.MoELM(model_cfg, moe_cfg, shard_mesh=(
+            mesh if args.moe_dispatch == "ragged" else None))
     else:
         model = llama.LlamaLM(model_cfg)
 
@@ -255,11 +260,12 @@ def main(argv: list[str] | None = None) -> dict:
             mesh, impl=model_cfg.attention_impl)
 
     # Chunked CE defaults on for the 8B preset, where the [B,S,V] logits
-    # tensor (V=128256) is the single largest activation in the step.
-    # MoE runs compose with it since round 5 (moe.loss_fn chunked=True);
-    # their default stays off (32k-vocab presets gain nothing, BENCHMARKS).
+    # tensor (V=128256) is the single largest activation in the step —
+    # MoE included (moe.loss_fn composes since round 5; an 8B-vocab MoE
+    # run has the same logits hazard). 32k-vocab presets gain nothing
+    # from it (BENCHMARKS), so their default stays off.
     chunked = (args.chunked_ce if args.chunked_ce is not None
-               else (args.preset == "8b" and not args.moe_experts))
+               else args.preset == "8b")
 
     # LM convention: --num-steps is the optimizer-step budget as given (the
     # reference's steps//world rule, tensorflow_mnist.py:146, presumes a fixed

@@ -20,10 +20,11 @@ TPU-first with two dispatch mechanisms, both fully static-shaped:
   Pallas grouped matmuls (:mod:`ops.pallas_gmm`) whose per-expert MXU work
   is proportional to REAL tokens. Removes both the ≥20% zero-padding the
   capacity buffers multiply at cf=1.25 and the capacity-overflow drops.
-  Single-shard expert compute: under an expert-sharded mesh XLA cannot
-  partition through the kernel (use ``"index"`` there — the EP dryrun
-  does); the win is the dense-expert/data-parallel regime the MoE bench
-  measures.
+  Batch-parallel via ``shard_mesh`` (the whole dispatch shard_maps over
+  the mesh's data/fsdp axes — a Pallas call has no GSPMD rule, so
+  unwrapped it would run replicated on every device); the EXPERT axis
+  remains the index path's domain (use ``"index"`` with EP — the EP
+  dryrun does).
 
 Expert parallelism falls out of the logical-axis system: expert weights carry
 the "expert" logical axis -> the rule table maps it to the "expert" mesh axis
@@ -151,6 +152,16 @@ def _router_aux(logits: jax.Array, probs: jax.Array,
     }
 
 
+def _ragged_aux(f: jax.Array, p: jax.Array, z: jax.Array) -> dict:
+    """Final aux dict from (possibly batch-pmean'd) routing statistics:
+    f = mean first-choice assignment [E], p = mean router probs [E],
+    z = mean router z-loss. Dropless ⇒ fraction_dropped is exactly 0."""
+    e = f.shape[0]
+    return {"load_balance_loss": e * jnp.sum(f * p),
+            "router_z_loss": z,
+            "fraction_dropped": jnp.zeros((), jnp.float32)}
+
+
 def _expert_choice_picks(logits: jax.Array, capacity: int):
     """Expert-choice selection shared by both dispatch paths: each expert
     takes its top-``capacity`` tokens by softmax affinity. Returns
@@ -264,6 +275,9 @@ class MoEMLP(nn.Module):
 
     cfg: TransformerConfig
     moe: MoEConfig
+    # Mesh for shard_mapping the ragged dispatch over batch axes (see
+    # _ragged_dispatch). A static module attribute, like Block.attention_fn.
+    shard_mesh: Any = None
 
     @nn.compact
     def __call__(self, x: jax.Array, decode: bool = False) -> jax.Array:
@@ -419,7 +433,57 @@ class MoEMLP(nn.Module):
         as the capacity paths, just with per-expert ragged offsets instead
         of a fixed-capacity clamp) and the expert SwiGLU runs as three
         grouped matmuls whose MXU work tracks real token counts. No
-        capacity ⇒ no overflow drops and no zero-padding compute."""
+        capacity ⇒ no overflow drops and no zero-padding compute.
+
+        With ``shard_mesh`` set, the whole dispatch shard_maps over the
+        mesh's batch axes (data × fsdp): a Pallas call has no GSPMD
+        partitioning rule, so without the wrap every device all-gathers
+        the batch and runs ALL the expert compute (verified in the
+        compiled HLO — same hole the mesh attention fn closes). Dropless
+        routing is strictly per-token, so shard-local dispatch is EXACT:
+        only the position-in-buffer differs, never any token's output.
+        Router aux losses pmean over the batch axes (equal shards ⇒ the
+        global batch mean). Expert weights stay replicated inside the
+        wrap — the expert axis remains the index path's domain."""
+        mesh = self.shard_mesh
+        if mesh is not None:
+            sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+            # "sequence" belongs in the row partition too: the flattened
+            # [b*s, d] token dim is sharded (data, fsdp) on b (major) and
+            # sequence on s (minor) — exactly this axis product — and
+            # per-token dispatch makes sequence-local dispatch as exact
+            # as batch-local. Without it a CP mesh would all-gather the
+            # sequence shards into the grouped GEMM (review catch).
+            batch_axes = tuple(a for a in ("data", "fsdp", "sequence")
+                               if sizes.get(a, 1) > 1)
+            bfac = 1
+            for a in batch_axes:
+                bfac *= sizes[a]
+            if batch_axes and tokens.shape[0] % bfac == 0:
+                from jax.sharding import PartitionSpec as P
+                bspec, rep = P(batch_axes), P()
+
+                def inner(tk, lg, wg, wu, wd):
+                    y, (f, p, z) = self._ragged_core(tk, lg, wg, wu, wd)
+                    # pmean the ROUTING STATISTICS, not per-shard losses:
+                    # the load-balance loss is E·Σ_e f̄_e·p̄_e of GLOBAL
+                    # means — averaging per-shard Σ f·p would differ
+                    # (mean of products ≠ product of means) and break
+                    # exact parity with the unsharded path.
+                    stats = jax.lax.pmean((f, p, z), batch_axes)
+                    return y, stats
+
+                y, (f, p, z) = jax.shard_map(
+                    inner, mesh=mesh,
+                    in_specs=(bspec, bspec, rep, rep, rep),
+                    out_specs=(bspec, rep), check_vma=False)(
+                    tokens, logits, w_gate, w_up, w_down)
+                return y, _ragged_aux(f, p, z)
+        y, (f, p, z) = self._ragged_core(tokens, logits, w_gate, w_up,
+                                         w_down)
+        return y, _ragged_aux(f, p, z)
+
+    def _ragged_core(self, tokens, logits, w_gate, w_up, w_down):
         from k8s_distributed_deeplearning_tpu.ops import pallas_gmm
 
         cfg, moe = self.cfg, self.moe
@@ -469,9 +533,12 @@ class MoEMLP(nn.Module):
         for c in range(k):
             y = y + (jnp.take(ys, dests[c], axis=0)
                      * gate_stack[c][:, None].astype(cfg.dtype))
-        aux = dict(_router_aux(logits, probs, assign[0]),
-                   fraction_dropped=jnp.zeros((), jnp.float32))
-        return y, aux
+        # Raw routing statistics, not losses: the caller (sharded or not)
+        # forms the load-balance loss from (pmean'd) means via
+        # _ragged_aux, keeping sharded and unsharded numerics identical.
+        f = jnp.mean(assign[0], axis=0)
+        p = jnp.mean(probs, axis=0)
+        return y, (f, p, _z_loss(logits))
 
 
 class MoELM(nn.Module):
@@ -503,6 +570,7 @@ class MoELM(nn.Module):
 
     cfg: TransformerConfig
     moe: MoEConfig
+    shard_mesh: Any = None   # forwarded to MoEMLP (ragged batch shard_map)
 
     @nn.compact
     def __call__(self, tokens, *, positions=None, segment_ids=None,
@@ -516,7 +584,8 @@ class MoELM(nn.Module):
                 "routes differently from training. Use routing='topk' for "
                 "causal LMs (see MoELM docstring).",
                 UserWarning, stacklevel=2)
-        factory = functools.partial(MoEMLP, moe=self.moe)
+        factory = functools.partial(MoEMLP, moe=self.moe,
+                                    shard_mesh=self.shard_mesh)
         x = Transformer(self.cfg, mlp_factory=factory, name="transformer")(
             tokens, positions=positions, segment_ids=segment_ids,
             deterministic=deterministic,
@@ -583,7 +652,6 @@ def loss_fn(model: MoELM, moe: MoEConfig, params, batch, rng=None, *,
     apply_kw = dict(segment_ids=seg_in, positions=positions,
                     deterministic=rng is None, rngs=rngs,
                     attention_fn=attention_fn, mutable=["intermediates"])
-    denom = jnp.maximum(mask.sum(), 1.0)
 
     if chunked:
         from k8s_distributed_deeplearning_tpu.models.llama import unembedding
@@ -599,6 +667,7 @@ def loss_fn(model: MoELM, moe: MoEConfig, params, batch, rng=None, *,
         logits, state = model.apply({"params": params}, inputs, **apply_kw)
         ce_tok = optax.softmax_cross_entropy_with_integer_labels(logits,
                                                                  targets)
+        denom = jnp.maximum(mask.sum(), 1.0)   # chunked CE normalizes itself
         ce = (ce_tok * mask).sum() / denom
         acc = ((logits.argmax(-1) == targets) * mask).sum() / denom
 

@@ -536,3 +536,71 @@ def test_moe_chunked_ce_matches_unchunked():
                    key=lambda kv: str(kv[0]))):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=5e-5, atol=1e-6, err_msg=str(ks_))
+
+
+@pytest.mark.parametrize("spec", [{"data": 8}, {"data": 2, "sequence": 4}])
+def test_ragged_shard_mesh_matches_unsharded(spec):
+    """shard_map'd ragged dispatch (shard_mesh set) must equal the
+    unwrapped path exactly — dropless routing is per-token, so
+    shard-local dispatch changes buffer positions, never outputs — and
+    must actually SHARD the grouped-GEMM operands (without the wrap a
+    Pallas call has no GSPMD rule and every device computes the global
+    batch; verified here by the compiled per-device tensor shapes).
+    Covers the sequence axis too: the flattened token dim is sharded
+    (data, fsdp, sequence), so CP meshes partition the expert compute."""
+    mesh8 = mesh_lib.make_mesh(spec)
+    cfg = llama.config_tiny(dtype=jnp.float32, n_layers=2, scan_layers=False)
+    mcfg = moe.MoEConfig(num_experts=4, top_k=2, dispatch="ragged",
+                         ragged_block_m=8)
+    m_plain = moe.MoELM(cfg, mcfg)
+    m_shard = moe.MoELM(cfg, mcfg, shard_mesh=mesh8)
+    toks = jax.random.randint(jax.random.key(3), (8, 17), 0, cfg.vocab_size)
+    params = m_plain.init(jax.random.key(1), toks)["params"]
+
+    l_p, a_p = moe.loss_fn(m_plain, mcfg, params, {"tokens": toks})
+    with mesh8:
+        l_s, a_s = jax.jit(lambda p, b: moe.loss_fn(m_shard, mcfg, p, b))(
+            params, {"tokens": toks})
+    np.testing.assert_allclose(float(l_s), float(l_p), rtol=2e-5)
+    np.testing.assert_allclose(float(a_s["aux_loss"]),
+                               float(a_p["aux_loss"]), rtol=2e-5)
+    g_p = jax.grad(lambda p: moe.loss_fn(m_plain, mcfg, p,
+                                         {"tokens": toks})[0])(params)
+    with mesh8:
+        g_s = jax.jit(jax.grad(lambda p: moe.loss_fn(
+            m_shard, mcfg, p, {"tokens": toks})[0]))(params)
+    for (ks_, a), (_, b) in zip(
+            sorted(jax.tree_util.tree_flatten_with_path(g_p)[0],
+                   key=lambda kv: str(kv[0])),
+            sorted(jax.tree_util.tree_flatten_with_path(g_s)[0],
+                   key=lambda kv: str(kv[0]))):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-5, err_msg=str(ks_))
+
+
+def test_ragged_shard_mesh_shards_the_compute(mesh8):
+    """The sharding FACT: under dp8 with shard_mesh, the compiled step's
+    grouped-GEMM row dimension is the per-device token count, not the
+    global batch (the replication hole this wrap closes)."""
+    import re
+
+    cfg = llama.config_tiny(dtype=jnp.float32, n_layers=1, scan_layers=False,
+                            dim=128, mlp_dim=256)
+    mcfg = moe.MoEConfig(num_experts=4, top_k=2, dispatch="ragged",
+                         ragged_block_m=64)
+    model = moe.MoELM(cfg, mcfg, shard_mesh=mesh8)
+    tr = sharding.ShardedTrainer(
+        lambda p, b, r: moe.loss_fn(model, mcfg, p, b, r),
+        optax.adam(1e-3), mesh8)
+    state = tr.init(lambda r: model.init(
+        r, jnp.zeros((1, 8), jnp.int32))["params"], jax.random.key(0))
+    toks = jax.random.randint(jax.random.key(1), (64, 65), 0, cfg.vocab_size)
+    batch = tr.shard_batch({"tokens": toks})
+    txt = tr.make_step(donate=False).lower(
+        state, batch, jax.random.key(0)).compile().as_text()
+    # Global T*k = 64*64*2 = 8192 -> global m_pad >= 8192; per-device
+    # T*k = 1024 -> local m_pad = 1024 + 4*64 = 1280. The compiled
+    # module must contain the LOCAL padded buffer and never the global.
+    rows = {int(m.group(1)) for m in re.finditer(r"f32\[(\d+),128\]", txt)}
+    assert 1280 in rows, sorted(rows, reverse=True)[:5]
+    assert not any(r >= 8192 for r in rows), sorted(rows, reverse=True)[:5]
