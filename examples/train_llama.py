@@ -329,14 +329,16 @@ def main(argv: list[str] | None = None) -> dict:
                 "whole-corpus host pass): pass --data-path FILE, not a "
                 "shard directory")
         probe = data_lib.TokenShardBatcher(
-            args.data_path, per_host, seq_len, seed=conf.seed)
+            args.data_path, per_host, seq_len, seed=conf.seed,
+            vocab_size=model_cfg.vocab_size)
         n_eval = max(2 * (seq_len + 1),
                      min(probe.final_shard_tokens // 10, 64 * seq_len))
         batcher = data_lib.TokenShardBatcher(
             args.data_path, per_host, seq_len, seed=conf.seed,
             process_index=topo.process_index,
             num_processes=topo.num_processes,
-            hold_out_tail=n_eval)
+            hold_out_tail=n_eval,
+            vocab_size=model_cfg.vocab_size)
         eval_tokens = batcher.tail_tokens()
         metrics_extra = {"data": "sharded-streaming",
                          "num_windows": batcher.num_windows}

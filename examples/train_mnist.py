@@ -195,10 +195,14 @@ def run_accuracy_gate(data_dir: str, checkpoint_dir: str,
         "--checkpoint-dir", checkpoint_dir,
         "--log-every", "500",
     ])
-    assert result.get("eval_examples") == 10_000, (
-        "gate must cover the full test split", result)
+    # RuntimeError, not assert: gate checks must survive `python -O`
+    # (assertions compile away and the gate would silently pass).
+    if result.get("eval_examples") != 10_000:
+        raise RuntimeError(
+            f"gate must cover the full test split, got {result!r}")
     acc = float(result["accuracy"])
-    assert acc >= 0.99, f"north-star gate FAILED: {acc:.4f} < 0.99"
+    if acc < 0.99:
+        raise RuntimeError(f"north-star gate FAILED: {acc:.4f} < 0.99")
     return acc
 
 
@@ -235,11 +239,15 @@ def run_digits_gate(checkpoint_dir: str, steps: int | None = None,
         "--checkpoint-dir", checkpoint_dir,
         "--log-every", "500",
     ])
-    assert result.get("eval_examples") == 400, (
-        "gate must cover the full held-out split", result)
+    # RuntimeError, not assert: must survive `python -O` (see
+    # run_accuracy_gate).
+    if result.get("eval_examples") != 400:
+        raise RuntimeError(
+            f"gate must cover the full held-out split, got {result!r}")
     acc = float(result["accuracy"])
-    assert acc >= threshold, (
-        f"real-digits convergence gate FAILED: {acc:.4f} < {threshold}")
+    if acc < threshold:
+        raise RuntimeError(
+            f"real-digits convergence gate FAILED: {acc:.4f} < {threshold}")
     return acc
 
 

@@ -7,6 +7,8 @@ Usage:
   python -m k8s_distributed_deeplearning_tpu.launch validate --workers 4
   python -m k8s_distributed_deeplearning_tpu.launch run-local --workers 2 \
       -- --num-steps 40 --no-eval
+  python -m k8s_distributed_deeplearning_tpu.launch serve \
+      --preset tiny --requests 32 --slots 4
 
 ``validate`` runs the offline structural checks and, when kubectl can reach
 a cluster, a server-side dry-run. ``run-local`` executes the rendered pod
@@ -27,6 +29,12 @@ from k8s_distributed_deeplearning_tpu.launch import render, validate
 
 def main(argv: list[str] | None = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "serve":
+        # The serving CLI has its own argument surface (model preset,
+        # workload shape) rather than the JobConfig manifest knobs — and
+        # importing jax eagerly here would slow every render/validate call.
+        from k8s_distributed_deeplearning_tpu.serve import cli as serve_cli
+        return serve_cli.main(argv[1:])
     script_args: list[str] = []
     if "--" in argv:
         i = argv.index("--")

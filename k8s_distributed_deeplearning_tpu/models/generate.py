@@ -28,6 +28,68 @@ import jax.numpy as jnp
 PyTree = Any
 
 
+def prefill(model, params: PyTree, prompt: jax.Array, *,
+            positions: jax.Array | None = None,
+            segment_ids: jax.Array | None = None
+            ) -> tuple[jax.Array, PyTree]:
+    """Run ``prompt`` ([B, S] int32) through decode mode, creating and
+    filling a fresh KV cache sized by the model's ``max_seq_len``.
+
+    Returns ``(logits, cache)``: logits are [B, S, V] (the next token
+    samples from column len-1 of its row), cache is the mutable "cache"
+    collection ready for :func:`decode_step` / :func:`slot_decode_step`.
+    This is the prompt-ingest half of the old monolithic ``_generate``;
+    the serving engine (serve/engine.py) calls it per admission with a
+    [1, P] prompt and splices the result into its slot arena.
+    """
+    kw: dict = {}
+    if positions is not None:
+        kw["positions"] = positions
+    if segment_ids is not None:
+        kw["segment_ids"] = segment_ids
+    logits, vars_ = model.apply({"params": params}, prompt, decode=True,
+                                mutable=["cache"], **kw)
+    return logits, vars_["cache"]
+
+
+def decode_step(model, params: PyTree, cache: PyTree, token: jax.Array, *,
+                positions: jax.Array | None = None,
+                segment_ids: jax.Array | None = None
+                ) -> tuple[jax.Array, PyTree]:
+    """One shared-cursor decode step: ``token`` [B] int32 enters at the
+    cache's scalar cursor for every row. Returns ``(logits, cache)`` with
+    logits [B, V] for the next position. All rows advance in lockstep —
+    the contract of the one-shot ``generate()`` scan body."""
+    kw: dict = {}
+    if positions is not None:
+        kw["positions"] = positions
+    if segment_ids is not None:
+        kw["segment_ids"] = segment_ids
+    logits, vars_ = model.apply({"params": params, "cache": cache},
+                                token[:, None], decode=True,
+                                mutable=["cache"], **kw)
+    return logits[:, -1, :], vars_["cache"]
+
+
+def slot_decode_step(model, params: PyTree, cache: PyTree,
+                     tokens: jax.Array, slot_positions: jax.Array
+                     ) -> tuple[jax.Array, PyTree]:
+    """One SLOT decode step: row i's ``tokens[i]`` is written at that
+    row's own cursor ``slot_positions[i]`` ([B] int32) and attends to its
+    row prefix ``0..slot_positions[i]`` only (models/transformer.py slot
+    branch). Rows live independent lifetimes — the continuous-batching
+    engine's per-iteration program. Returns ``(logits, cache)`` with
+    logits [B, V]. The caller owns cursor arithmetic (pass position =
+    tokens-written-so-far for each row) and must keep ``slot_positions``
+    within ``max_seq_len``; stale KV beyond a row's cursor is never
+    attended, so freed slots are reusable without clearing."""
+    logits, vars_ = model.apply({"params": params, "cache": cache},
+                                tokens[:, None], decode=True,
+                                cache_positions=slot_positions,
+                                mutable=["cache"])
+    return logits[:, -1, :], vars_["cache"]
+
+
 def filter_logits(logits: jax.Array, top_k: int | None = None,
                   top_p: float | None = None) -> jax.Array:
     """Top-k / nucleus (top-p) filtering on a [..., V] logits slice: tokens
@@ -179,9 +241,7 @@ def _generate(model, params: PyTree, prompt: jax.Array,
                                0, None),
             segment_ids=ok)
     # Prefill: run the prompt through decode mode, filling the cache.
-    logits, vars_ = model.apply({"params": params}, prompt, decode=True,
-                                mutable=["cache"], **prefill_kw)
-    cache = vars_["cache"]
+    logits, cache = prefill(model, params, prompt, **prefill_kw)
 
     def sample(logits_last, step_rng):
         if not greedy:
@@ -212,14 +272,12 @@ def _generate(model, params: PyTree, prompt: jax.Array,
             # Unpadded learned-position decode: step t's token occupies
             # absolute slot s + t (prefill filled 0..s-1).
             step_kw["positions"] = jnp.full((b, 1), s + t, jnp.int32)
-        logits, vars_ = model.apply({"params": params, "cache": cache},
-                                    token[:, None], decode=True,
-                                    mutable=["cache"], **step_kw)
-        nxt = sample(logits[:, -1, :], step_rng).astype(jnp.int32)
+        logits, cache = decode_step(model, params, cache, token, **step_kw)
+        nxt = sample(logits, step_rng).astype(jnp.int32)
         if eos_id is not None:
             nxt = jnp.where(alive, nxt, pad_id)
             alive = alive & (nxt != eos_id)
-        return (vars_["cache"], nxt, alive), nxt
+        return (cache, nxt, alive), nxt
 
     n_rest = max(max_new_tokens - 1, 0)
     steps = (jax.random.split(rng, n_rest), jnp.arange(n_rest))

@@ -36,11 +36,13 @@ class LlamaLM(nn.Module):
                  deterministic: bool = True,
                  attention_fn=None,
                  decode: bool = False,
+                 cache_positions: jax.Array | None = None,
                  return_hidden: bool = False) -> jax.Array:
         x = Transformer(self.cfg, name="transformer")(
             tokens, positions=positions, segment_ids=segment_ids,
             deterministic=deterministic,
-            attention_fn=attention_fn, decode=decode)
+            attention_fn=attention_fn, decode=decode,
+            cache_positions=cache_positions)
         if return_hidden:
             # Final hidden states for a chunked LM-head loss
             # (ops/chunked_ce.py). Only valid at apply time: init must take

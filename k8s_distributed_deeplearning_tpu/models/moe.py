@@ -57,6 +57,10 @@ from k8s_distributed_deeplearning_tpu.models.transformer import (
 
 Dtype = Any
 
+# One-time latch for the ragged indivisible-batch fallback warning (decode
+# path only; training raises). A list so tests can clear it.
+_RAGGED_FALLBACK_WARNED: list[bool] = []
+
 
 @dataclasses.dataclass(frozen=True)
 class MoEConfig:
@@ -329,7 +333,8 @@ class MoEMLP(nn.Module):
                 # less MLP work. Single-shard expert compute, like
                 # ragged training.
                 y, _ = self._ragged_dispatch(tokens, logits,
-                                             w_gate, w_up, w_down)
+                                             w_gate, w_up, w_down,
+                                             decode=True)
                 return y.reshape(b, s, d)
             # Serving path: DROPLESS top-k via the index dispatch with
             # capacity = T (no token can overflow a T-deep buffer, so
@@ -426,7 +431,8 @@ class MoEMLP(nn.Module):
                              axis=0) * w
         return y, aux
 
-    def _ragged_dispatch(self, tokens, logits, w_gate, w_up, w_down):
+    def _ragged_dispatch(self, tokens, logits, w_gate, w_up, w_down,
+                         decode=False):
         """Dropless grouped-GEMM dispatch (``ops.pallas_gmm``): tokens
         scatter into one flat [M_pad, d] buffer sorted by expert
         (block-aligned ragged layout — the SAME cumsum position accounting
@@ -479,6 +485,23 @@ class MoEMLP(nn.Module):
                     out_specs=(bspec, rep), check_vma=False)(
                     tokens, logits, w_gate, w_up, w_down)
                 return y, _ragged_aux(f, p, z)
+            if batch_axes:
+                # The fallback below runs UNSHARDED: a Pallas call has no
+                # GSPMD rule, so every device all-gathers the batch and
+                # runs the FULL expert compute — bfac× silent replication.
+                # A mis-sized training batch must fail loudly; decode
+                # (arbitrary serving widths) warns once and proceeds.
+                msg = (f"MoE ragged dispatch: token count {tokens.shape[0]}"
+                       f" does not divide the mesh batch factor {bfac} "
+                       f"({'×'.join(batch_axes)}) — expert compute will run"
+                       " unsharded (replicated on every device). Size the "
+                       "batch×sequence product to a multiple of the mesh "
+                       "batch axes.")
+                if not decode:
+                    raise ValueError(msg)
+                if not _RAGGED_FALLBACK_WARNED:
+                    _RAGGED_FALLBACK_WARNED.append(True)
+                    warnings.warn(msg, RuntimeWarning, stacklevel=2)
         y, (f, p, z) = self._ragged_core(tokens, logits, w_gate, w_up,
                                          w_down)
         return y, _ragged_aux(f, p, z)

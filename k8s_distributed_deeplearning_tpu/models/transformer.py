@@ -237,6 +237,17 @@ class Attention(nn.Module):
     ``dynamic_update_slice``, never a growing array) live in the mutable
     "cache" collection; each call appends the current chunk and attends the
     chunk's queries against the cache prefix.
+
+    ``cache_positions`` ([B] int32) selects SLOT decode mode (the
+    continuous-batching serving engine, :mod:`serve.engine`): each batch
+    row is an independent request slot with its OWN cursor — the
+    single-token chunk writes at per-row column ``cache_positions[b]``
+    (a row-indexed scatter instead of the shared-cursor
+    ``dynamic_update_slice``) and attends columns ``<= cache_positions[b]``.
+    Columns beyond a slot's cursor are never read, so a freed slot can be
+    re-filled by a new request's prefill without clearing the stale K/V the
+    previous occupant left behind. The shared scalar ``cache_index`` is
+    untouched: per-slot lengths are the caller's registers.
     """
 
     cfg: TransformerConfig
@@ -247,7 +258,8 @@ class Attention(nn.Module):
                  positions: jax.Array | None = None,
                  segment_ids: jax.Array | None = None,
                  attention_fn: Callable | None = None,
-                 decode: bool = False) -> jax.Array:
+                 decode: bool = False,
+                 cache_positions: jax.Array | None = None) -> jax.Array:
         cfg = self.cfg
         hd = cfg.resolved_head_dim
         q = nn.DenseGeneral((cfg.n_heads, hd), axis=-1, use_bias=False,
@@ -266,6 +278,8 @@ class Attention(nn.Module):
                                 default_init(), ("embed", "kv", "head_dim")),
                             name="v_proj")(x)
         cur = None
+        if cache_positions is not None and not decode:
+            raise ValueError("cache_positions requires decode=True")
         if decode:
             if mask is not None or attention_fn is not None:
                 raise NotImplementedError(
@@ -274,6 +288,17 @@ class Attention(nn.Module):
                     "silently wrong")
             b, sq = x.shape[0], x.shape[1]
             kv = cfg.resolved_kv_heads
+            if cache_positions is not None:
+                if sq != 1:
+                    raise ValueError(
+                        f"slot decode (cache_positions) is strictly "
+                        f"token-at-a-time: got a chunk of {sq} — prefill a "
+                        "slot through the shared-cursor path and splice")
+                if segment_ids is not None:
+                    raise NotImplementedError(
+                        "slot decode isolates rows by construction (each "
+                        "slot is one request); segment_ids have no meaning "
+                        "here")
             # Cache layout [B, S, kv·hd] — heads FOLDED into the lane dim.
             # The natural [B, S, kv, hd] layout tiles its (kv, hd) minors
             # to (8, 128): at 4 KV heads × head_dim 64 the buffer occupies
@@ -299,23 +324,48 @@ class Attention(nn.Module):
                                        (b, cfg.max_seq_len), jnp.int32)
             cache_index = self.variable("cache", "cache_index",
                                         lambda: jnp.zeros((), jnp.int32))
-            cur = cache_index.value
-            if use_seg:
-                seg_now = segment_ids.astype(jnp.int32)
-                cached_seg.value = jax.lax.dynamic_update_slice(
-                    cached_seg.value, seg_now, (0, cur))
-            segment_ids = None     # consumed into the cache mask below
-            if positions is None:
-                # Absolute positions for RoPE: the cache cursor onward.
-                # (Left-padded callers pass explicit per-row positions.)
-                positions = (cur + jnp.arange(sq))[None, :]
+            if cache_positions is not None:
+                # Slot mode: per-row cursors own positions; the shared
+                # scalar cursor and the seg-validity machinery stay idle.
+                if positions is None:
+                    positions = cache_positions[:, None]
+            else:
+                cur = cache_index.value
+                if use_seg:
+                    seg_now = segment_ids.astype(jnp.int32)
+                    cached_seg.value = jax.lax.dynamic_update_slice(
+                        cached_seg.value, seg_now, (0, cur))
+                segment_ids = None     # consumed into the cache mask below
+                if positions is None:
+                    # Absolute positions for RoPE: the cache cursor onward.
+                    # (Left-padded callers pass explicit per-row positions.)
+                    positions = (cur + jnp.arange(sq))[None, :]
 
         if cfg.position == "rope":
             cos, sin = rope_frequencies(hd, cfg.max_seq_len, cfg.rope_theta)
             q = apply_rope(q, cos, sin, positions)
             k = apply_rope(k, cos, sin, positions)
 
-        if decode:
+        if decode and cache_positions is not None:
+            # Slot decode: the [B, 1] chunk scatters into per-row columns
+            # (each slot's own cursor) and each row attends its prefix
+            # col <= cursor — including the just-written token, so even a
+            # cursor-0 idle slot has one finite score (no NaN softmax).
+            b = x.shape[0]
+            kv = cfg.resolved_kv_heads
+            k_all = cached_k.value.at[jnp.arange(b), cache_positions].set(
+                k.reshape(b, kv * hd).astype(cached_k.value.dtype))
+            v_all = cached_v.value.at[jnp.arange(b), cache_positions].set(
+                v.reshape(b, kv * hd).astype(cached_v.value.dtype))
+            cached_k.value, cached_v.value = k_all, v_all
+            k_all = k_all.reshape(b, cfg.max_seq_len, kv, hd)
+            v_all = v_all.reshape(b, cfg.max_seq_len, kv, hd)
+            col = jnp.arange(cfg.max_seq_len)
+            dmask = (col[None, :]
+                     <= cache_positions[:, None])[:, None, None, :]
+            out = attention_ops.multi_head_attention(
+                q, k_all, v_all, causal=False, mask=dmask, impl="xla")
+        elif decode:
             # Append this chunk at the cursor (static-shape cache update) and
             # attend the chunk's queries against the cache prefix: query at
             # absolute position cur+i sees columns <= cur+i.
@@ -437,14 +487,16 @@ class Block(nn.Module):
                  segment_ids: jax.Array | None = None,
                  deterministic: bool = True,
                  attention_fn: Callable | None = None,
-                 decode: bool = False) -> jax.Array:
+                 decode: bool = False,
+                 cache_positions: jax.Array | None = None) -> jax.Array:
         cfg = self.cfg
         attention_fn = attention_fn or self.attention_fn
         h = make_norm(cfg, "attn_norm")(x)
         h = Attention(cfg, name="attn")(h, mask=mask, positions=positions,
                                         segment_ids=segment_ids,
                                         attention_fn=attention_fn,
-                                        decode=decode)
+                                        decode=decode,
+                                        cache_positions=cache_positions)
         if cfg.dropout_rate:
             h = nn.Dropout(cfg.dropout_rate, deterministic=deterministic)(h)
         x = x + h
@@ -478,7 +530,8 @@ class Transformer(nn.Module):
                  segment_ids: jax.Array | None = None,
                  deterministic: bool = True,
                  attention_fn: Callable | None = None,
-                 decode: bool = False) -> jax.Array:
+                 decode: bool = False,
+                 cache_positions: jax.Array | None = None) -> jax.Array:
         cfg = self.cfg
         if tokens_or_embeds.dtype in (jnp.int32, jnp.int64):
             x = nn.Embed(cfg.vocab_size, cfg.dim, dtype=cfg.dtype,
@@ -490,12 +543,17 @@ class Transformer(nn.Module):
             x = tokens_or_embeds.astype(cfg.dtype)
         if cfg.position == "learned":
             if decode and positions is None:
-                # The cache cursor lives inside Attention; learned positions
-                # would need it at embed time. RoPE models (the causal-LM
-                # families) are unaffected.
-                raise NotImplementedError(
-                    "decode with position='learned' requires explicit "
-                    "positions — pass positions=cache_cursor + arange(S)")
+                if cache_positions is not None:
+                    # Slot decode carries per-row cursors — exactly the
+                    # absolute positions the embedding needs.
+                    positions = cache_positions[:, None]
+                else:
+                    # The cache cursor lives inside Attention; learned
+                    # positions would need it at embed time. RoPE models
+                    # (the causal-LM families) are unaffected.
+                    raise NotImplementedError(
+                        "decode with position='learned' requires explicit "
+                        "positions — pass positions=cache_cursor + arange(S)")
             pos = positions if positions is not None else jnp.arange(x.shape[1])
             x = x + nn.Embed(cfg.max_seq_len, cfg.dim, dtype=cfg.dtype,
                              param_dtype=jnp.float32,
@@ -516,6 +574,8 @@ class Transformer(nn.Module):
         # traced, which would turn the static `decode` python bool into a
         # tracer (remat is never combined with decode — guarded above).
         dkw = {"decode": True} if decode else {}
+        if cache_positions is not None:
+            dkw["cache_positions"] = cache_positions
         if cfg.scan_layers:
             x, _ = nn.scan(
                 lambda mdl, carry, _: (

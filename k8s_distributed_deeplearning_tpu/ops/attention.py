@@ -170,8 +170,13 @@ def make_mesh_attention_fn(mesh, *, impl: str = "auto"):
         use_b = batch_axes if b % bfac == 0 else ()
         use_h = (head_axis if head_axis and hq % hfac == 0
                  and hkv % hfac == 0 else None)
+        # Broadcast mask dims (size 1) are shardable: the spec builder
+        # below replicates them (spec None), so only a non-broadcast dim
+        # that doesn't divide its mesh factor forces the fallback.
         mask_ok = mask is None or (
-            mask.ndim == 4 and (not use_b or mask.shape[0] % bfac == 0)
+            mask.ndim == 4
+            and (mask.shape[0] == 1 or not use_b
+                 or mask.shape[0] % bfac == 0)
             and (mask.shape[1] == 1 or use_h is None
                  or mask.shape[1] % hfac == 0))
         if (not use_b and use_h is None) or not mask_ok:

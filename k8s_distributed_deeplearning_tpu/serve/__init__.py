@@ -1,0 +1,15 @@
+"""Continuous-batching serving (iteration-level scheduling over a slot arena).
+
+The one-shot :func:`models.generate.generate` path pins a batch's wall-clock
+to its longest request; this package serves mixed-length traffic through ONE
+shape-static compiled decode step over a persistent per-layer KV arena, with
+freed slots re-admitted in flight (Orca-style iteration scheduling + vLLM-style
+slot reuse). See :mod:`serve.engine` for the design contract.
+"""
+from k8s_distributed_deeplearning_tpu.serve.engine import ServeEngine
+from k8s_distributed_deeplearning_tpu.serve.request import (
+    QueueFull, Request, RequestOutput, SamplingParams)
+from k8s_distributed_deeplearning_tpu.serve.scheduler import RequestQueue
+
+__all__ = ["ServeEngine", "Request", "RequestOutput", "SamplingParams",
+           "RequestQueue", "QueueFull"]

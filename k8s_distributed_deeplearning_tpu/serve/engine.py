@@ -1,0 +1,378 @@
+"""Continuous-batching engine: ONE compiled decode step over a slot arena.
+
+Design contract (the compile-once discipline that makes in-flight admission
+free):
+
+- The KV cache is a persistent ``[num_slots, max_seq_len, kv·head_dim]``
+  per-layer ARENA (the folded-head decode layout, models/transformer.py).
+  Slots are the unit of admission. Each slot carries a host-side register
+  file (last token, KV length = next write position, sampling params, PRNG
+  key) that enters the decode program as small ``[num_slots]`` operands.
+- The decode step is SHAPE-STATIC: ``slot_decode_step`` writes each slot's
+  token at that slot's own cursor and masks attention to ``col <= cursor``
+  per row (slot mode in models/transformer.py), so slots live independent
+  lifetimes inside one program. It compiles exactly once and reruns for
+  every serving iteration regardless of admissions or completions —
+  asserted via jit cache-size instrumentation in tests/test_serve.py.
+- Admission (slot freed by EOS / length cap / startup): the next queued
+  request prefills on a right-padded ``[1, bucket]`` prompt through the
+  ordinary shared-cursor decode path (one compile per power-of-two length
+  bucket), and the resulting single-row cache is spliced into the freed
+  slot with ``dynamic_update_slice``. The slot rejoins the decode batch on
+  the next iteration — no drain, no recompile.
+- Stale-KV safety: columns beyond a slot's cursor are never attended, and
+  decode writes land at the cursor BEFORE attention reads, so freed slots
+  are reusable without clearing and right-pad garbage in the prefill
+  bucket is progressively overwritten unobserved.
+- Per-slot sampling params are traced array operands (``temperature <= 0``
+  => greedy; ``top_k == 0`` / ``top_p == 1.0`` => off), so heterogeneous
+  sampling across slots never recompiles.
+
+Greedy decoding through this engine is token-identical to one-shot
+``generate()`` for the same prompt: prefill runs at the arena's full cache
+width and the per-row slot mask selects exactly the columns the shared
+cursor would (parity asserted in tests/test_serve.py).
+"""
+from __future__ import annotations
+
+import functools
+import time
+from typing import Any, Iterable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from k8s_distributed_deeplearning_tpu.models import generate
+from k8s_distributed_deeplearning_tpu.serve.request import (
+    Request, RequestOutput)
+from k8s_distributed_deeplearning_tpu.serve.scheduler import RequestQueue
+from k8s_distributed_deeplearning_tpu.utils.metrics import ServingStats
+
+PyTree = Any
+
+
+def _sample_slots(logits: jax.Array, temps: jax.Array, top_ks: jax.Array,
+                  top_ps: jax.Array, keys: jax.Array
+                  ) -> tuple[jax.Array, jax.Array]:
+    """Per-slot sampling with TRACED params: logits [B, V], temps [B] f32,
+    top_ks [B] int32 (0 = off), top_ps [B] f32 (1.0 = off), keys [B, 2]
+    uint32 (legacy PRNG keys — a plain array, so the register file stays
+    ``.at``-updatable). Returns (new_keys, tokens [B] int32).
+
+    Same k-then-p semantics as :func:`models.generate.filter_logits`, but
+    with k and p as array operands (one descending sort serves both); rows
+    with ``temperature <= 0`` take the argmax instead.
+    """
+    v = logits.shape[-1]
+    greedy_tok = jnp.argmax(logits, axis=-1)
+    scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
+    sorted_desc = -jnp.sort(-scaled, axis=-1)
+    k_eff = jnp.where(top_ks <= 0, v, jnp.clip(top_ks, 1, v))
+    kth = jnp.take_along_axis(sorted_desc, (k_eff - 1)[:, None], axis=-1)
+    filt = jnp.where(scaled < kth, -jnp.inf, scaled)
+    sorted_k = jnp.where(jnp.arange(v)[None, :] < k_eff[:, None],
+                         sorted_desc, -jnp.inf)
+    probs = jax.nn.softmax(sorted_k, axis=-1)
+    exclusive = jnp.cumsum(probs, axis=-1) - probs
+    n_keep = jnp.maximum(
+        jnp.sum(exclusive < top_ps[:, None], axis=-1, keepdims=True), 1)
+    thresh = jnp.take_along_axis(sorted_k, n_keep - 1, axis=-1)
+    filt = jnp.where(filt < thresh, -jnp.inf, filt)
+
+    def one(key, row):
+        new, sub = jax.random.split(key)
+        return new, jax.random.categorical(sub, row)
+
+    new_keys, sampled = jax.vmap(one)(keys, filt)
+    toks = jnp.where(temps <= 0.0, greedy_tok, sampled).astype(jnp.int32)
+    return new_keys, toks
+
+
+@functools.partial(jax.jit, static_argnames=("model",),
+                   donate_argnames=("cache",))
+def _decode_program(model, params: PyTree, cache: PyTree, tokens: jax.Array,
+                    kv_lens: jax.Array, temps: jax.Array, top_ks: jax.Array,
+                    top_ps: jax.Array, keys: jax.Array):
+    """THE serving iteration: every slot advances one token. Free slots ride
+    along as inert rows (their writes land in slots the next admission
+    wholesale overwrites). Compiles once per (model, num_slots)."""
+    logits, cache = generate.slot_decode_step(model, params, cache, tokens,
+                                              kv_lens)
+    keys, nxt = _sample_slots(logits, temps, top_ks, top_ps, keys)
+    return nxt, keys, cache
+
+
+@functools.partial(jax.jit, static_argnames=("model",))
+def _prefill_program(model, params: PyTree, prompt: jax.Array,
+                     length: jax.Array, temp: jax.Array, top_k: jax.Array,
+                     top_p: jax.Array, key: jax.Array):
+    """Prefill a right-padded [1, bucket] prompt at the arena's full cache
+    width and sample the first token from column ``length - 1`` (the
+    length is a traced operand — one compile per bucket, not per prompt
+    length). Right padding is causal-safe: real token i attends 0..i."""
+    logits, cache = generate.prefill(model, params, prompt)
+    last = jax.lax.dynamic_slice_in_dim(logits, length - 1, 1, axis=1)[:, 0, :]
+    new_key, tok = _sample_slots(last, temp[None], top_k[None], top_p[None],
+                                 key[None])
+    return tok[0], new_key[0], cache
+
+
+@functools.partial(jax.jit, donate_argnames=("arena",))
+def _splice_program(arena: PyTree, pre: PyTree, slot: jax.Array) -> PyTree:
+    """Splice a single-request prefill cache into arena slot ``slot`` (a
+    traced scalar — one compile per bucket). The slot axis of each leaf is
+    the axis where the prefill cache is size 1 and the arena isn't —
+    covers both the unrolled [B, S, F] and layer-scanned [L, B, S, F]
+    cache layouts. Shape-equal leaves (the scalar shared cursor, unused in
+    slot mode) keep the arena's value."""
+    def leaf(a, p):
+        if a.shape == p.shape:
+            return a
+        for i, (ps, as_) in enumerate(zip(p.shape, a.shape)):
+            if ps == 1 and as_ != 1:
+                return jax.lax.dynamic_update_slice_in_dim(
+                    a, p.astype(a.dtype), slot, axis=i)
+        raise ValueError(
+            f"cannot locate slot axis: arena leaf {a.shape} vs prefill leaf "
+            f"{p.shape}")
+    return jax.tree.map(leaf, arena, pre)
+
+
+class _InFlight:
+    """Host-side record for the request occupying a slot."""
+
+    __slots__ = ("req", "tokens", "t_submit", "t_admit", "t_first")
+
+    def __init__(self, req: Request, first_token: int, t_admit: float):
+        self.req = req
+        self.tokens = [first_token]
+        self.t_submit = req._t_submit if req._t_submit is not None else t_admit
+        self.t_admit = t_admit
+        self.t_first = t_admit
+
+
+class ServeEngine:
+    """Synchronous continuous-batching engine over a slot arena.
+
+    Usage::
+
+        eng = ServeEngine(model, params, num_slots=8, eos_id=2)
+        eng.submit(Request(prompt=[...], max_new_tokens=64))
+        outputs = eng.run()          # drain queue + in-flight to completion
+
+    or drive iteration-by-iteration with :meth:`step` (each call = one
+    decode iteration preceded by admissions into any free slots) and stream
+    tokens via ``Request.on_token``. ``num_slots >= 2`` (a 1-slot arena is
+    not batched serving, and slot-axis splicing needs a distinguishable
+    batch axis).
+    """
+
+    def __init__(self, model, params: PyTree, *, num_slots: int = 8,
+                 max_queue: int = 256, eos_id: int | None = None,
+                 pad_id: int = 0, min_bucket: int = 32,
+                 stats: ServingStats | None = None):
+        if num_slots < 2:
+            raise ValueError(f"num_slots must be >= 2, got {num_slots}")
+        cfg = getattr(model, "cfg", None)
+        max_seq = getattr(cfg, "max_seq_len", None)
+        if max_seq is None:
+            raise ValueError("model.cfg.max_seq_len is required — it sizes "
+                             "the KV arena")
+        self.model = model
+        self.params = params
+        self.num_slots = num_slots
+        self.max_seq_len = int(max_seq)
+        self.eos_id = eos_id
+        self.pad_id = pad_id
+        self.min_bucket = min_bucket
+        self.stats = stats if stats is not None else ServingStats()
+        self.queue = RequestQueue(max_queue)
+        # Per-slot register file (host numpy; fixed dtypes so the decode
+        # program's operand signature — and thus its compilation — never
+        # changes). kv_lens doubles as the next write position.
+        self._tokens = np.full(num_slots, pad_id, np.int32)
+        self._kv_lens = np.zeros(num_slots, np.int32)
+        self._temps = np.zeros(num_slots, np.float32)
+        self._top_ks = np.zeros(num_slots, np.int32)
+        self._top_ps = np.ones(num_slots, np.float32)
+        self._keys = np.zeros((num_slots, 2), np.uint32)
+        self._slots: list[_InFlight | None] = [None] * num_slots
+        self._cache = self._init_arena()
+
+    def _init_arena(self) -> PyTree:
+        """Zero-filled arena with the exact leaf structure a prefill
+        produces (eval_shape: no FLOPs, no allocation). KV content is
+        irrelevant — nothing is attended until a splice installs it."""
+        dummy = jnp.zeros((self.num_slots, 1), jnp.int32)
+        _, shapes = jax.eval_shape(
+            lambda p, t: generate.prefill(self.model, p, t),
+            self.params, dummy)
+        return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes)
+
+    # ---------------------------------------------------------------- API
+
+    def submit(self, req: Request) -> str:
+        """Queue a request (FCFS). Raises QueueFull when the bounded queue
+        is at capacity and ValueError for requests that could never run."""
+        n = len(req.prompt)
+        if n < 1:
+            raise ValueError("empty prompt")
+        if req.max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {req.max_new_tokens}")
+        if n + req.max_new_tokens > self.max_seq_len:
+            raise ValueError(
+                f"prompt ({n}) + max_new_tokens ({req.max_new_tokens}) "
+                f"exceeds max_seq_len ({self.max_seq_len}) — the slot's KV "
+                "region would overflow")
+        req._t_submit = time.perf_counter()
+        self.queue.submit(req)
+        return req.request_id
+
+    def step(self) -> list[RequestOutput]:
+        """One serving iteration: admit queued requests into free slots,
+        then advance every occupied slot one token. Returns the requests
+        that finished during this iteration (possibly at admission, when
+        the first token is already EOS or ``max_new_tokens == 1``)."""
+        outputs: list[RequestOutput] = []
+        for slot in range(self.num_slots):
+            while self._slots[slot] is None and len(self.queue):
+                done = self._admit(slot, self.queue.pop())
+                if done is None:
+                    break           # slot occupied; next slot
+                outputs.append(done)  # finished at admission; slot still free
+        active = sum(s is not None for s in self._slots)
+        if active == 0:
+            return outputs
+        nxt, keys, self._cache = _decode_program(
+            self.model, self.params, self._cache, self._tokens,
+            self._kv_lens, self._temps, self._top_ks, self._top_ps,
+            self._keys)
+        nxt = np.asarray(nxt)   # the iteration's honest host sync
+        # np.array (copy), not np.asarray: the zero-copy view of a jax CPU
+        # buffer is read-only, and admissions write per-slot keys in place.
+        self._keys = np.array(keys)
+        self.stats.record_step(active, self.num_slots)
+        for slot, fl in enumerate(self._slots):
+            if fl is None:
+                continue
+            tok = int(nxt[slot])
+            # The PREVIOUS token was just written at kv_lens; the freshly
+            # sampled one becomes the next step's input.
+            self._kv_lens[slot] += 1
+            self._tokens[slot] = tok
+            fl.tokens.append(tok)
+            if fl.req.on_token is not None:
+                fl.req.on_token(tok)
+            if self.eos_id is not None and tok == self.eos_id:
+                outputs.append(self._finish(slot, "eos"))
+            elif len(fl.tokens) >= fl.req.max_new_tokens:
+                outputs.append(self._finish(slot, "length"))
+        return outputs
+
+    def run(self, requests: Iterable[Request] | None = None,
+            max_steps: int | None = None) -> list[RequestOutput]:
+        """Submit *requests* (optional) and step until queue and slots are
+        empty. Returns outputs in completion order."""
+        if requests is not None:
+            for r in requests:
+                self.submit(r)
+        outputs: list[RequestOutput] = []
+        steps = 0
+        while len(self.queue) or any(s is not None for s in self._slots):
+            outputs.extend(self.step())
+            steps += 1
+            if max_steps is not None and steps >= max_steps:
+                break
+        return outputs
+
+    def shutdown(self) -> list[RequestOutput]:
+        """Abort everything: queued requests (no tokens) and in-flight
+        requests (partial tokens) all complete with finish_reason
+        "aborted". The engine is reusable afterwards."""
+        outs: list[RequestOutput] = []
+        now = time.perf_counter()
+        for req in self.queue.drain():
+            t0 = req._t_submit if req._t_submit is not None else now
+            outs.append(RequestOutput(
+                request_id=req.request_id, prompt_len=len(req.prompt),
+                tokens=[], finish_reason="aborted", queue_s=now - t0,
+                ttft_s=None, latency_s=now - t0))
+        for slot, fl in enumerate(self._slots):
+            if fl is not None:
+                outs.append(self._finish(slot, "aborted"))
+        return outs
+
+    def decode_cache_size(self) -> int:
+        """Compiled-program count of the decode step (jit cache entries,
+        shared across engines in the process) — the instrumentation behind
+        the compiles-once acceptance test: run a workload, take the delta."""
+        return _decode_program._cache_size()
+
+    @staticmethod
+    def prefill_cache_size() -> int:
+        """Compiled-program count of the prefill step (≤ one per bucket)."""
+        return _prefill_program._cache_size()
+
+    # ----------------------------------------------------------- internals
+
+    def _bucket(self, n: int) -> int:
+        b = self.min_bucket
+        while b < n:
+            b *= 2
+        return min(b, self.max_seq_len)
+
+    def _admit(self, slot: int, req: Request) -> RequestOutput | None:
+        """Prefill *req* into *slot*. Returns a RequestOutput when the
+        request finished at admission (first token was EOS, or the length
+        budget is a single token) — the slot stays free in that case."""
+        n = len(req.prompt)
+        bucket = self._bucket(n)
+        padded = np.full((1, bucket), self.pad_id, np.int32)
+        padded[0, :n] = np.asarray(req.prompt, np.int32)
+        sp = req.sampling
+        tok, key, pre = _prefill_program(
+            self.model, self.params, padded, np.int32(n),
+            np.float32(sp.temperature), np.int32(sp.top_k),
+            np.float32(sp.top_p),
+            np.asarray(jax.random.PRNGKey(req.seed), np.uint32))
+        self._cache = _splice_program(self._cache, pre, np.int32(slot))
+        first = int(tok)
+        now = time.perf_counter()
+        fl = _InFlight(req, first, now)
+        self._slots[slot] = fl
+        self._tokens[slot] = first
+        self._kv_lens[slot] = n          # next write position
+        self._temps[slot] = sp.temperature
+        self._top_ks[slot] = sp.top_k
+        self._top_ps[slot] = sp.top_p
+        self._keys[slot] = np.asarray(key)
+        self.stats.record_admission(queue_s=now - fl.t_submit, prompt_len=n)
+        self.stats.record_first_token(ttft_s=now - fl.t_submit)
+        if req.on_token is not None:
+            req.on_token(first)
+        if self.eos_id is not None and first == self.eos_id:
+            return self._finish(slot, "eos")
+        if req.max_new_tokens == 1:
+            return self._finish(slot, "length")
+        return None
+
+    def _finish(self, slot: int, reason: str) -> RequestOutput:
+        fl = self._slots[slot]
+        now = time.perf_counter()
+        out = RequestOutput(
+            request_id=fl.req.request_id, prompt_len=len(fl.req.prompt),
+            tokens=list(fl.tokens), finish_reason=reason,
+            queue_s=fl.t_admit - fl.t_submit,
+            ttft_s=fl.t_first - fl.t_submit,
+            latency_s=now - fl.t_submit)
+        self._slots[slot] = None
+        self._tokens[slot] = self.pad_id
+        self._kv_lens[slot] = 0
+        self._temps[slot] = 0.0
+        self._top_ks[slot] = 0
+        self._top_ps[slot] = 1.0
+        self.stats.record_completion(latency_s=out.latency_s,
+                                     n_tokens=len(out.tokens), reason=reason)
+        return out

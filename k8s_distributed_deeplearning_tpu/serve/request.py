@@ -1,0 +1,83 @@
+"""Request/response types for the serving engine.
+
+Sampling-parameter encoding is chosen for the engine's compile-once
+contract: every request's params become TRACED per-slot array operands of
+the one decode program (``temperature <= 0`` selects greedy, ``top_k == 0``
+and ``top_p == 1.0`` mean "off"), so heterogeneous sampling across slots
+never triggers a recompile.
+"""
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from typing import Callable, Sequence
+
+_req_counter = itertools.count()
+
+
+class QueueFull(RuntimeError):
+    """The engine's bounded admission queue rejected a submit (back-pressure
+    surfaces to the caller instead of growing memory without bound)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class SamplingParams:
+    """Per-request sampling knobs. Defaults are greedy decoding."""
+
+    temperature: float = 0.0   # <= 0 => greedy argmax
+    top_k: int = 0             # 0 => no top-k filter
+    top_p: float = 1.0         # 1.0 => no nucleus filter
+
+    def __post_init__(self):
+        if self.top_k < 0:
+            raise ValueError(f"top_k must be >= 0 (0 = off), got {self.top_k}")
+        if not 0.0 < self.top_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {self.top_p}")
+        if self.temperature <= 0.0 and (self.top_k > 0 or self.top_p < 1.0):
+            raise ValueError(
+                "top_k/top_p require temperature > 0 (greedy ignores them — "
+                "silently dropping the request would mislead)")
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request.
+
+    ``on_token`` (optional) streams each emitted token id as soon as the
+    host observes it — called in emission order, including the first
+    (prefill-sampled) token and any terminating EOS. ``seed`` makes sampled
+    decoding reproducible per request regardless of slot placement or
+    admission order (each slot carries its own PRNG key).
+    """
+
+    prompt: Sequence[int]
+    max_new_tokens: int
+    sampling: SamplingParams = dataclasses.field(default_factory=SamplingParams)
+    request_id: str = dataclasses.field(
+        default_factory=lambda: f"req-{next(_req_counter)}")
+    seed: int = 0
+    on_token: Callable[[int], None] | None = None
+    # Stamped by ServeEngine.submit (perf_counter clock); queue wait and
+    # TTFT are measured from this instant.
+    _t_submit: float | None = dataclasses.field(
+        default=None, repr=False, compare=False)
+
+
+@dataclasses.dataclass
+class RequestOutput:
+    """Terminal result for one request.
+
+    ``finish_reason``: "eos" (emitted the EOS token — included in
+    ``tokens``, matching ``generate()``), "length" (hit
+    ``max_new_tokens``), or "aborted" (engine shutdown; ``tokens`` holds
+    whatever was emitted, possibly nothing for never-admitted requests).
+    ``ttft_s`` is None for requests aborted before their first token.
+    """
+
+    request_id: str
+    prompt_len: int
+    tokens: list[int]
+    finish_reason: str
+    queue_s: float
+    ttft_s: float | None
+    latency_s: float

@@ -1,0 +1,41 @@
+"""Bounded FCFS admission queue.
+
+Deliberately minimal: admission ORDER is the whole policy (first come,
+first served into whichever slot frees up), and the bound is the
+back-pressure surface — a full queue raises :class:`QueueFull` at submit
+time instead of buffering unboundedly. Priority/fair-share policies would
+slot in here without touching the engine.
+"""
+from __future__ import annotations
+
+from collections import deque
+
+from k8s_distributed_deeplearning_tpu.serve.request import QueueFull, Request
+
+
+class RequestQueue:
+    """FIFO of pending :class:`Request`\\ s with a hard capacity."""
+
+    def __init__(self, max_size: int = 256):
+        if max_size < 1:
+            raise ValueError(f"max_size must be >= 1, got {max_size}")
+        self.max_size = max_size
+        self._q: deque[Request] = deque()
+
+    def submit(self, req: Request) -> None:
+        if len(self._q) >= self.max_size:
+            raise QueueFull(
+                f"admission queue is full ({self.max_size} pending) — retry "
+                f"after completions free capacity (request {req.request_id})")
+        self._q.append(req)
+
+    def pop(self) -> Request | None:
+        return self._q.popleft() if self._q else None
+
+    def drain(self) -> list[Request]:
+        out = list(self._q)
+        self._q.clear()
+        return out
+
+    def __len__(self) -> int:
+        return len(self._q)

@@ -308,7 +308,15 @@ def load_tokens(path: str | None, *, num_tokens: int = 1 << 17,
                 f"--data-path {path!r} does not exist; omit it for synthetic "
                 "tokens")
         if path.endswith(".npy"):
-            return np.load(path).astype(np.int32)
+            arr = np.load(path).astype(np.int32)
+            if arr.size and (int(arr.min()) < 0
+                             or int(arr.max()) >= vocab_size):
+                raise ValueError(
+                    f"token ids in {path!r} fall outside [0, {vocab_size}):"
+                    f" min {int(arr.min())}, max {int(arr.max())} — "
+                    "out-of-range ids would clamp silently in the embedding"
+                    " gather; fix the data or pass the right vocab_size")
+            return arr
         if path.endswith(".gz"):
             with gzip.open(path, "rb") as f:
                 raw = np.frombuffer(f.read(), dtype=np.uint8)
@@ -556,11 +564,16 @@ class TokenShardBatcher(_EpochShardedBatcher):
 
     def __init__(self, data_dir: str, batch_size: int, seq_len: int,
                  seed: int = 0, process_index: int = 0,
-                 num_processes: int = 1, hold_out_tail: int = 0):
+                 num_processes: int = 1, hold_out_tail: int = 0,
+                 vocab_size: int | None = None):
         """*hold_out_tail* excludes the last N tokens of the final shard
         from the training window space (the held-out eval slice — read it
         via :meth:`tail_tokens`; without the exclusion, eval tokens would
-        also appear in training epochs)."""
+        also appear in training epochs). *vocab_size* (when given) range-
+        checks the FIRST and LAST shard's token ids — cheap relative to a
+        full-corpus scan, and catches the common corruptions (wrong
+        tokenizer, wrong dtype decode, truncation garbage) at both ends
+        instead of letting the embedding gather clamp them silently."""
         if seq_len <= 0:
             raise ValueError("seq_len must be positive")
         names = sorted(n for n in os.listdir(data_dir)
@@ -587,6 +600,16 @@ class TokenShardBatcher(_EpochShardedBatcher):
             if arr.ndim != 1:
                 raise ValueError(f"shard {n!r} must be 1-D, got {arr.shape}")
             self._shards.append(arr)
+        if vocab_size is not None:
+            for i in sorted({0, len(self._shards) - 1}):
+                arr = self._shards[i]
+                if arr.size and (int(arr.min()) < 0
+                                 or int(arr.max()) >= vocab_size):
+                    raise ValueError(
+                        f"shard {names[i]!r}: token ids outside "
+                        f"[0, {vocab_size}) (min {int(arr.min())}, max "
+                        f"{int(arr.max())}) — out-of-range ids would clamp "
+                        "silently in the embedding gather")
         self.hold_out_tail = hold_out_tail
         if hold_out_tail and hold_out_tail >= len(self._shards[-1]):
             raise ValueError(

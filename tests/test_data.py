@@ -240,3 +240,35 @@ def test_vendored_corpus_loads_and_is_real_text():
     text = bytes(toks[:4096].astype(np.uint8)).decode("utf-8")
     # Real English prose, not noise: common words appear.
     assert "the" in text and "statement" in text
+
+
+def test_load_tokens_npy_validates_vocab_range(tmp_path):
+    """Out-of-range ids in a pretokenized .npy would clamp silently in the
+    embedding gather; load_tokens must reject them up front."""
+    good = tmp_path / "good.npy"
+    np.save(good, np.array([0, 5, 255], np.int32))
+    np.testing.assert_array_equal(
+        data_lib.load_tokens(str(good), vocab_size=256), [0, 5, 255])
+    bad = tmp_path / "bad.npy"
+    np.save(bad, np.array([0, 300], np.int32))
+    try:
+        data_lib.load_tokens(str(bad), vocab_size=256)
+        raise AssertionError("out-of-range ids must raise")
+    except ValueError as e:
+        assert "outside" in str(e) and "300" in str(e)
+
+
+def test_shard_batcher_validates_vocab_range(tmp_path):
+    """TokenShardBatcher(vocab_size=...) range-checks the first and last
+    shard at construction — wrong tokenizer / dtype-decode corruption
+    fails at startup, not as silent embedding clamping mid-run."""
+    _write_shards(tmp_path, total=4096, n_shards=2)   # ids in [0, 32000)
+    b = data_lib.TokenShardBatcher(str(tmp_path), batch_size=2, seq_len=32,
+                                   vocab_size=32000)
+    assert b.num_windows > 0
+    try:
+        data_lib.TokenShardBatcher(str(tmp_path), batch_size=2, seq_len=32,
+                                   vocab_size=1000)
+        raise AssertionError("under-sized vocab must raise")
+    except ValueError as e:
+        assert "outside" in str(e)

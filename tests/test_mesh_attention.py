@@ -142,3 +142,29 @@ def test_act_embed_rule_keeps_batch_on_both_axes():
     with mesh:
         y = jax.jit(f)(x)
     assert y.sharding.spec == P(("data", "fsdp"),)
+
+
+@pytest.mark.skipif(not hasattr(jax, "shard_map"),
+                    reason="jax.shard_map not in this jax version (the "
+                           "sharded path itself is untestable, same as the "
+                           "other mesh-path tests)")
+def test_mesh_attention_broadcast_batch_mask(mesh3):
+    """A mask carrying a size-1 batch dim ([1, 1, s, s] — the common
+    'same additive mask for every row' shape) must ride the SHARDED path:
+    broadcast dims are replicated by the spec builder, so batch
+    divisibility doesn't apply to them. Before the fix this shape fell
+    back to unwrapped attention (1 % bfac != 0)."""
+    q, k, v = _qkv()
+    s = q.shape[1]
+    row = jnp.arange(s)[:, None]
+    col = jnp.arange(s)[None, :]
+    pmask = (((col < s // 2) | (row >= col))[None, None]).astype(jnp.bool_)
+    assert pmask.shape == (1, 1, s, s)
+    fn = att.make_mesh_attention_fn(mesh3, impl="xla")
+    ref = att.multi_head_attention(q, k, v, mask=pmask, impl="xla")
+    out = jax.jit(lambda a, b_, c, m: fn(a, b_, c, mask=m))(q, k, v, pmask)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=1e-5)
+    # The sharded path actually ran: output lands batch-over-data x fsdp,
+    # heads-over-tensor, not the fallback's unsharded layout.
+    assert out.sharding.spec == P(("data", "fsdp"), None, "tensor")

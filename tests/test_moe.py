@@ -604,3 +604,53 @@ def test_ragged_shard_mesh_shards_the_compute(mesh8):
     rows = {int(m.group(1)) for m in re.finditer(r"f32\[(\d+),128\]", txt)}
     assert 1280 in rows, sorted(rows, reverse=True)[:5]
     assert not any(r >= 8192 for r in rows), sorted(rows, reverse=True)[:5]
+
+
+def test_ragged_indivisible_fallback_raises_under_training(mesh8):
+    """A token count that doesn't divide the mesh batch factor can't use
+    the shard_map wrap — the Pallas grouped GEMM has no GSPMD rule, so
+    the fallback silently replicates the FULL expert compute on every
+    device. A mis-sized training batch must fail loudly, not train at
+    bfac x the cost."""
+    cfg = llama.config_tiny(dtype=jnp.float32, n_layers=1, scan_layers=False)
+    mcfg = moe.MoEConfig(num_experts=4, top_k=2, dispatch="ragged",
+                         ragged_block_m=8)
+    model = moe.MoELM(cfg, mcfg, shard_mesh=mesh8)
+    toks = jax.random.randint(jax.random.key(0), (3, 6), 0, cfg.vocab_size)
+    # init through a plain model (identical param structure) so the
+    # indivisible apply is the FIRST thing the sharded model traces
+    params = moe.MoELM(cfg, mcfg).init(jax.random.key(1), toks)["params"]
+    # flattened t = 3*6 = 18, not a multiple of the 8-way batch factor
+    with pytest.raises(ValueError, match="does not divide"):
+        moe.loss_fn(model, mcfg, params, {"tokens": toks})
+
+
+def test_ragged_indivisible_fallback_warns_once_at_decode(mesh8):
+    """Serving widths are arbitrary, so decode keeps the unsharded
+    fallback — but says so exactly once (RuntimeWarning), because the
+    replication cost is invisible otherwise."""
+    import warnings
+
+    cfg = llama.config_tiny(dtype=jnp.float32, n_layers=1, scan_layers=False,
+                            max_seq_len=256)
+    mcfg = moe.MoEConfig(num_experts=4, top_k=2, dispatch="ragged",
+                         ragged_block_m=8)
+    model = moe.MoELM(cfg, mcfg, shard_mesh=mesh8)
+    # wide prompt: t = 129 >= 128 crosses into the ragged prefill path
+    # and 129 % 8 != 0 triggers the fallback
+    toks = jax.random.randint(jax.random.key(0), (1, 129), 0, cfg.vocab_size)
+    params = moe.MoELM(cfg, mcfg).init(jax.random.key(1),
+                                       toks[:, :8])["params"]
+    moe._RAGGED_FALLBACK_WARNED.clear()
+    try:
+        with warnings.catch_warnings(record=True) as rec:
+            warnings.simplefilter("always")
+            model.apply({"params": params}, toks, decode=True,
+                        mutable=["cache"])
+            model.apply({"params": params}, toks, decode=True,
+                        mutable=["cache"])
+        hits = [w for w in rec if issubclass(w.category, RuntimeWarning)
+                and "does not divide" in str(w.message)]
+        assert len(hits) == 1, [str(w.message) for w in rec]
+    finally:
+        moe._RAGGED_FALLBACK_WARNED.clear()
