@@ -113,6 +113,7 @@ class JobConfig:
     cpu: str = "2"                   # worker resources (tensorflow-mnist.yaml:49-53)
     memory: str = "4Gi"
     coordinator_port: int = 8476
+    metrics_port: int = 9090         # Prometheus /metrics (+ /healthz) scrape
     clean_pod_policy: str = "Running"  # tensorflow-mnist.yaml:8
     tpu_chips_per_worker: int | None = None  # None -> derived from topology
 

@@ -76,6 +76,13 @@ def main(argv: list[str] | None = None) -> int:
     parsers["watch"].add_argument(
         "--no-apply", dest="apply_first", action="store_false",
         help="reconcile an already-applied job instead of applying first")
+    parsers["watch"].add_argument(
+        "--heartbeat-dir", default=None,
+        help="directory of per-rank heartbeat files (telemetry.heartbeat);"
+             " stale ranks are reported with their last-completed span")
+    parsers["watch"].add_argument(
+        "--heartbeat-stale-after", type=float, default=120.0,
+        help="seconds without a heartbeat before a rank counts as stalled")
     parsers["run-local"].add_argument("--timeout", type=int, default=600)
     parsers["run-local"].add_argument(
         "--max-restarts", type=int, default=0,
@@ -117,6 +124,8 @@ def main(argv: list[str] | None = None) -> int:
                 attempt_timeout=args.attempt_timeout,
                 poll_interval=args.poll_interval,
                 apply_first=args.apply_first,
+                heartbeat_dir=args.heartbeat_dir,
+                heartbeat_stale_after=args.heartbeat_stale_after,
                 on_event=lambda m: print(f"watch: {m}", file=sys.stderr))
         except (RuntimeError, ValueError) as e:
             print(f"watch failed: {e}", file=sys.stderr)

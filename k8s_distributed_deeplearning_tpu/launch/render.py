@@ -61,13 +61,17 @@ def render_tpujob(cfg: JobConfig) -> dict:
              "metadata.annotations['batch.kubernetes.io/job-completion-index']"}}},
         # Visibility for logs/metrics labels
         {"name": "TPUJOB_NAME", "value": cfg.name},
+        # Where the in-process telemetry exporter should bind (matches the
+        # prometheus.io/port scrape annotation below).
+        {"name": "TPUJOB_METRICS_PORT", "value": str(cfg.metrics_port)},
     ]
     container = {
         "name": "worker",
         "image": cfg.image,
         "command": ["python", cfg.script, *cfg.script_args],
         "env": env,
-        "ports": [{"containerPort": cfg.coordinator_port}],
+        "ports": [{"containerPort": cfg.coordinator_port},
+                  {"containerPort": cfg.metrics_port, "name": "metrics"}],
         "resources": {
             "requests": {"cpu": cfg.cpu, "memory": cfg.memory},
             "limits": {"cpu": cfg.cpu, "memory": cfg.memory,
@@ -91,7 +95,17 @@ def render_tpujob(cfg: JobConfig) -> dict:
             **({"ttlSecondsAfterFinished": 600}
                if cfg.clean_pod_policy != "None" else {}),
             "template": {
-                "metadata": {"labels": {"app": cfg.name}},
+                "metadata": {
+                    "labels": {"app": cfg.name},
+                    # Prometheus discovers worker /metrics endpoints via the
+                    # standard scrape annotations (the pull plane; Promtail
+                    # keeps owning stdout JSONL — telemetry/ serves both).
+                    "annotations": {
+                        "prometheus.io/scrape": "true",
+                        "prometheus.io/port": str(cfg.metrics_port),
+                        "prometheus.io/path": "/metrics",
+                    },
+                },
                 "spec": {
                     "subdomain": cfg.name,           # joins the headless svc
                     "restartPolicy": "OnFailure",

@@ -41,6 +41,19 @@ from k8s_distributed_deeplearning_tpu.launch.elastic import (  # noqa: F401
     ResizeFn,
     resize_to,
 )
+from k8s_distributed_deeplearning_tpu.telemetry import heartbeat as hb
+
+# Stderr substrings marking a kubectl failure as transient — an apiserver
+# blip worth retrying, not a config error worth surfacing.
+_TRANSIENT_MARKERS = ("timed out", "timeout", "connection refused",
+                      "connection reset", "tls handshake",
+                      "temporarily unavailable", "i/o timeout",
+                      "unexpected eof", "service unavailable")
+
+
+def _is_transient(text: str) -> bool:
+    low = text.lower()
+    return any(m in low for m in _TRANSIENT_MARKERS)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -58,11 +71,23 @@ class GangStatus:
 
 class Kubectl:
     """Thin shell client for the few verbs the watcher needs. *runner* is
-    injectable (tests script it); the default shells to ``kubectl``."""
+    injectable (tests script it); the default shells to ``kubectl``.
+
+    Transient failures (apiserver timeout, connection refused — the
+    blips a live reconcile loop WILL meet over hours) are retried up to
+    *retries* times with exponential backoff starting at *backoff_s*;
+    anything else (NotFound, Forbidden, bad manifest) surfaces
+    immediately. A watch must not die on the first network hiccup, and
+    must also not retry forever against a genuinely broken config."""
 
     def __init__(self, context: str | None = None,
-                 runner: Callable | None = None):
+                 runner: Callable | None = None, *,
+                 retries: int = 2, backoff_s: float = 1.0,
+                 sleep: Callable[[float], None] = time.sleep):
         self.context = context
+        self.retries = retries
+        self.backoff_s = backoff_s
+        self._sleep = sleep
         self._runner = runner or self._subprocess_runner
 
     def _subprocess_runner(self, args: list[str], input_text: str | None,
@@ -85,11 +110,29 @@ class Kubectl:
                 "reconcile loop)") from e
         return proc.returncode, proc.stdout, proc.stderr
 
-    def _run_kubectl(self, args, input_text=None, timeout=120.0):
+    def _call_runner(self, args, input_text, timeout):
         try:
             return self._runner(args, input_text, timeout)
         except TypeError:   # injected test runners take (args, input) only
             return self._runner(args, input_text)
+
+    def _run_kubectl(self, args, input_text=None, timeout=120.0):
+        """Run one kubectl verb with bounded transient-failure retry."""
+        delay = self.backoff_s
+        for attempt in range(self.retries + 1):
+            last = attempt == self.retries
+            try:
+                rc, out, err = self._call_runner(args, input_text, timeout)
+            except RuntimeError as e:
+                # kubectl-not-found is permanent; surfaced timeouts retry.
+                if last or not _is_transient(str(e)):
+                    raise
+            else:
+                if rc == 0 or last or not _is_transient(err):
+                    return rc, out, err
+            self._sleep(delay)
+            delay *= 2
+        raise AssertionError("unreachable")
 
     def apply(self, text: str) -> None:
         rc, _, err = self._run_kubectl(["apply", "-f", "-"], text)
@@ -142,7 +185,10 @@ def watch(cfg: JobConfig, *,
           apply_first: bool = True,
           on_event: Callable[[str], None] | None = None,
           clock: Callable[[], float] = time.monotonic,
-          sleep: Callable[[float], None] = time.sleep) -> WatchResult:
+          sleep: Callable[[float], None] = time.sleep,
+          heartbeat_dir: str | None = None,
+          heartbeat_stale_after: float = 120.0,
+          heartbeat_clock: Callable[[], float] = time.time) -> WatchResult:
     """Reconcile the gang against the cluster until it completes.
 
     Each ATTEMPT applies the rendered objects (validated first — the
@@ -155,10 +201,35 @@ def watch(cfg: JobConfig, *,
     with the last observed status.
 
     *clock*/*sleep* are injectable for deterministic unit tests.
+
+    *heartbeat_dir*: a directory of per-rank heartbeat files (workers write
+    them via :class:`telemetry.heartbeat.HeartbeatWriter`, typically on the
+    shared checkpoint volume). Each poll, a rank whose newest heartbeat is
+    older than *heartbeat_stale_after* seconds is reported through
+    *on_event* with its rank id, last step, and last-completed span — the
+    hung-collective mode becomes a NAMED diagnosis minutes in, rather than
+    an anonymous attempt timeout half an hour later. Ranks are re-reported
+    only after recovering (fresh heartbeat) and stalling again.
     """
     kubectl = kubectl or Kubectl()
     emit = on_event or (lambda _msg: None)
     restarts = 0
+    stalled_ranks: set[int] = set()     # currently-reported stalls
+
+    def check_heartbeats() -> None:
+        if heartbeat_dir is None:
+            return
+        stalls = hb.detect_stalls(heartbeat_dir, heartbeat_stale_after,
+                                  now=heartbeat_clock())
+        current = {s.rank for s in stalls}
+        for s in stalls:
+            if s.rank not in stalled_ranks:
+                emit(s.describe())
+        recovered = stalled_ranks - current
+        for r in sorted(recovered):
+            emit(f"rank {r} heartbeat recovered")
+        stalled_ranks.clear()
+        stalled_ranks.update(current)
 
     def apply_current(c: JobConfig) -> None:
         docs = render.render_all(c)
@@ -175,6 +246,7 @@ def watch(cfg: JobConfig, *,
         failed = False
         while clock() < deadline:
             status = kubectl.job_status(cfg)
+            check_heartbeats()
             if status.complete(cfg):
                 emit(f"complete: {status.succeeded}/{cfg.num_workers} "
                      "succeeded")

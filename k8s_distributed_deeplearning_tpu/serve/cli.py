@@ -44,6 +44,12 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--metrics-path", default=None,
                     help="also append JSONL events to this file")
+    ap.add_argument("--metrics-port", type=int, default=None,
+                    help="serve Prometheus /metrics (+ /healthz) on this "
+                         "port; serving gauges update per scrape")
+    ap.add_argument("--trace", action="store_true",
+                    help="emit span events (prefill/decode/admission) "
+                         "through the JSONL stream")
     args = ap.parse_args(argv)
 
     import jax
@@ -77,9 +83,23 @@ def main(argv: list[str] | None = None) -> int:
     sampling = SamplingParams(temperature=args.temperature,
                               top_k=args.top_k, top_p=args.top_p)
     logger = MetricsLogger(job="serve", path=args.metrics_path)
+    tracer = None
+    if args.trace:
+        from k8s_distributed_deeplearning_tpu.telemetry.trace import Tracer
+        tracer = Tracer(logger)
     engine = ServeEngine(model, params, num_slots=args.slots,
                          max_queue=args.max_queue or args.requests,
-                         eos_id=args.eos_id)
+                         eos_id=args.eos_id, tracer=tracer)
+    exporter = None
+    if args.metrics_port is not None:
+        from k8s_distributed_deeplearning_tpu.telemetry import bridge
+        from k8s_distributed_deeplearning_tpu.telemetry.exporter import (
+            MetricsExporter)
+        from k8s_distributed_deeplearning_tpu.telemetry.registry import (
+            MetricsRegistry)
+        registry = MetricsRegistry()
+        bridge.serving_collector(registry, engine.stats)
+        exporter = MetricsExporter(registry, port=args.metrics_port).start()
     for i in range(args.requests):
         prompt = rng.integers(0, cfg.vocab_size,
                               size=int(rng.integers(p_lo, p_hi + 1)))
@@ -103,6 +123,8 @@ def main(argv: list[str] | None = None) -> int:
     logger.emit("serve_summary", num_slots=args.slots,
                 preset=args.preset, **engine.stats.summary())
     logger.close()
+    if exporter is not None:
+        exporter.stop()
     return 0
 
 

@@ -47,7 +47,10 @@ from k8s_distributed_deeplearning_tpu.models import generate
 from k8s_distributed_deeplearning_tpu.serve.request import (
     Request, RequestOutput)
 from k8s_distributed_deeplearning_tpu.serve.scheduler import RequestQueue
+from k8s_distributed_deeplearning_tpu.telemetry.trace import Tracer
 from k8s_distributed_deeplearning_tpu.utils.metrics import ServingStats
+
+_NULL_TRACER = Tracer(enabled=False)
 
 PyTree = Any
 
@@ -171,7 +174,8 @@ class ServeEngine:
     def __init__(self, model, params: PyTree, *, num_slots: int = 8,
                  max_queue: int = 256, eos_id: int | None = None,
                  pad_id: int = 0, min_bucket: int = 32,
-                 stats: ServingStats | None = None):
+                 stats: ServingStats | None = None,
+                 tracer: Tracer | None = None):
         if num_slots < 2:
             raise ValueError(f"num_slots must be >= 2, got {num_slots}")
         cfg = getattr(model, "cfg", None)
@@ -187,6 +191,10 @@ class ServeEngine:
         self.pad_id = pad_id
         self.min_bucket = min_bucket
         self.stats = stats if stats is not None else ServingStats()
+        # Spans: "admission" (queue pop -> slot occupied, wrapping a
+        # "prefill" for the compiled prefill + splice) and "decode" (one
+        # arena-wide decode iteration incl. the host sync).
+        self.tracer = tracer if tracer is not None else _NULL_TRACER
         self.queue = RequestQueue(max_queue)
         # Per-slot register file (host numpy; fixed dtypes so the decode
         # program's operand signature — and thus its compilation — never
@@ -245,14 +253,16 @@ class ServeEngine:
         active = sum(s is not None for s in self._slots)
         if active == 0:
             return outputs
-        nxt, keys, self._cache = _decode_program(
-            self.model, self.params, self._cache, self._tokens,
-            self._kv_lens, self._temps, self._top_ks, self._top_ps,
-            self._keys)
-        nxt = np.asarray(nxt)   # the iteration's honest host sync
-        # np.array (copy), not np.asarray: the zero-copy view of a jax CPU
-        # buffer is read-only, and admissions write per-slot keys in place.
-        self._keys = np.array(keys)
+        with self.tracer.span("decode", active=active):
+            nxt, keys, self._cache = _decode_program(
+                self.model, self.params, self._cache, self._tokens,
+                self._kv_lens, self._temps, self._top_ks, self._top_ps,
+                self._keys)
+            nxt = np.asarray(nxt)   # the iteration's honest host sync
+            # np.array (copy), not np.asarray: the zero-copy view of a jax
+            # CPU buffer is read-only, and admissions write per-slot keys
+            # in place.
+            self._keys = np.array(keys)
         self.stats.record_step(active, self.num_slots)
         for slot, fl in enumerate(self._slots):
             if fl is None:
@@ -328,17 +338,20 @@ class ServeEngine:
         request finished at admission (first token was EOS, or the length
         budget is a single token) — the slot stays free in that case."""
         n = len(req.prompt)
-        bucket = self._bucket(n)
-        padded = np.full((1, bucket), self.pad_id, np.int32)
-        padded[0, :n] = np.asarray(req.prompt, np.int32)
-        sp = req.sampling
-        tok, key, pre = _prefill_program(
-            self.model, self.params, padded, np.int32(n),
-            np.float32(sp.temperature), np.int32(sp.top_k),
-            np.float32(sp.top_p),
-            np.asarray(jax.random.PRNGKey(req.seed), np.uint32))
-        self._cache = _splice_program(self._cache, pre, np.int32(slot))
-        first = int(tok)
+        with self.tracer.span("admission", prompt_len=n, slot=slot):
+            bucket = self._bucket(n)
+            padded = np.full((1, bucket), self.pad_id, np.int32)
+            padded[0, :n] = np.asarray(req.prompt, np.int32)
+            sp = req.sampling
+            with self.tracer.span("prefill", bucket=bucket):
+                tok, key, pre = _prefill_program(
+                    self.model, self.params, padded, np.int32(n),
+                    np.float32(sp.temperature), np.int32(sp.top_k),
+                    np.float32(sp.top_p),
+                    np.asarray(jax.random.PRNGKey(req.seed), np.uint32))
+                self._cache = _splice_program(self._cache, pre,
+                                              np.int32(slot))
+                first = int(tok)
         now = time.perf_counter()
         fl = _InFlight(req, first, now)
         self._slots[slot] = fl

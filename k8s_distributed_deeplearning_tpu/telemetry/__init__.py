@@ -1,0 +1,32 @@
+"""Telemetry subsystem: span tracing, Prometheus exposition, heartbeats.
+
+Three planes, one contract:
+
+- :mod:`telemetry.trace` — nested context-manager spans emitted as JSONL
+  ``span`` events through the existing :class:`utils.metrics.MetricsLogger`
+  stdout→Promtail→Loki pipeline (the reference's log plane, unchanged).
+- :mod:`telemetry.registry` + :mod:`telemetry.exporter` — a dependency-free
+  Counter/Gauge/Histogram registry with Prometheus text exposition served
+  from a stdlib-threaded ``/metrics`` endpoint (the pull plane the reference
+  never had; its Grafana could only read Loki).
+- :mod:`telemetry.heartbeat` — per-rank liveness files consumed by
+  ``launch watch`` so a hung collective is *detected* (stalled rank id +
+  last-completed span) instead of silently burning an attempt timeout.
+
+:mod:`telemetry.events` is the golden registry of JSONL event names — the
+schema contract Loki queries and dashboard panels depend on.
+"""
+from k8s_distributed_deeplearning_tpu.telemetry.events import EVENTS
+from k8s_distributed_deeplearning_tpu.telemetry.heartbeat import (
+    HeartbeatWriter, StallReport, detect_stalls, read_heartbeats)
+from k8s_distributed_deeplearning_tpu.telemetry.registry import (
+    Counter, Gauge, Histogram, MetricsRegistry)
+from k8s_distributed_deeplearning_tpu.telemetry.exporter import (
+    MetricsExporter)
+from k8s_distributed_deeplearning_tpu.telemetry.trace import Tracer
+
+__all__ = [
+    "Counter", "EVENTS", "Gauge", "HeartbeatWriter", "Histogram",
+    "MetricsExporter", "MetricsRegistry", "StallReport", "Tracer",
+    "detect_stalls", "read_heartbeats",
+]

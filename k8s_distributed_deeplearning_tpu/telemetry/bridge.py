@@ -1,0 +1,160 @@
+"""Bridges between existing state and the Prometheus registry.
+
+The registry (:mod:`telemetry.registry`) is deliberately dumb — names and
+numbers. This module owns the *semantics*: which gauges the train loop
+updates, how :class:`utils.metrics.ServingStats` maps onto the scrape
+surface, and the host/device resource probes (RSS from ``/proc``, device
+memory from JAX's per-device allocator stats). Everything here degrades to
+a no-op off Linux / off TPU — a scrape must never crash the workload.
+"""
+from __future__ import annotations
+
+from typing import TYPE_CHECKING
+
+from k8s_distributed_deeplearning_tpu.telemetry.registry import (
+    MetricsRegistry)
+
+if TYPE_CHECKING:
+    from k8s_distributed_deeplearning_tpu.utils.metrics import ServingStats
+
+
+def host_rss_bytes() -> int | None:
+    """Resident set size from ``/proc/self/statm`` (None off Linux)."""
+    try:
+        import os
+        with open("/proc/self/statm") as f:
+            pages = int(f.read().split()[1])
+        return pages * os.sysconf("SC_PAGE_SIZE")
+    except (OSError, ValueError, IndexError):
+        return None
+
+
+def device_memory_stats() -> dict[str, int]:
+    """``bytes_in_use``/``peak_bytes_in_use`` summed over local devices.
+
+    JAX backends without allocator stats (CPU, some plugins) return {} —
+    callers simply skip the gauges."""
+    try:
+        import jax
+        totals: dict[str, int] = {}
+        for d in jax.local_devices():
+            stats = d.memory_stats()
+            if not stats:
+                continue
+            for k in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit"):
+                if k in stats:
+                    totals[k] = totals.get(k, 0) + int(stats[k])
+        return totals
+    except Exception:
+        return {}
+
+
+class TrainTelemetry:
+    """The train loop's gauge set, updated at the existing ``log_every``
+    cadence (the loss fetch is already the host sync point — piggybacking
+    there adds no extra device round-trip)."""
+
+    def __init__(self, registry: MetricsRegistry, rank: int = 0):
+        self.registry = registry
+        self.rank = rank
+        self.steps = registry.counter(
+            "train_steps_total", "optimizer steps completed")
+        self.step_time = registry.gauge(
+            "train_step_time_ms", "mean step wall time over the last window")
+        self.examples = registry.gauge(
+            "train_examples_per_sec", "global examples (or tokens) per sec")
+        self.loss = registry.gauge("train_loss", "last logged training loss")
+        self.mfu = registry.gauge(
+            "train_mfu", "model FLOPs utilization (0..1)")
+        self.checkpoints = registry.counter(
+            "train_checkpoints_total", "checkpoint writes")
+        self.rss = registry.gauge(
+            "process_resident_memory_bytes", "host RSS of this process")
+        self.dev_mem = registry.gauge(
+            "jax_device_bytes", "summed local-device allocator stats",
+            labelnames=("stat",))
+
+    def on_log(self, *, steps_in_window: int, loss: float,
+               step_time_ms: float, examples_per_sec: float,
+               mfu: float | None) -> None:
+        self.steps.inc(steps_in_window)
+        self.step_time.set(step_time_ms)
+        self.examples.set(examples_per_sec)
+        self.loss.set(loss)
+        if mfu is not None:
+            self.mfu.set(mfu)
+        rss = host_rss_bytes()
+        if rss is not None:
+            self.rss.set(rss)
+        for k, v in device_memory_stats().items():
+            self.dev_mem.labels(stat=k).set(v)
+
+    def on_checkpoint(self) -> None:
+        self.checkpoints.inc()
+
+
+def serving_collector(registry: MetricsRegistry,
+                      stats: "ServingStats") -> None:
+    """Register a pull-time collector mapping ``ServingStats.summary()``
+    onto serve gauges — the scrape reads whatever the engine has
+    aggregated so far, with no push on the decode path."""
+    g = {
+        "serve_requests_admitted": registry.gauge(
+            "serve_requests_admitted", "requests admitted into slots"),
+        "serve_requests_completed": registry.gauge(
+            "serve_requests_completed", "requests completed"),
+        "serve_tokens_per_sec": registry.gauge(
+            "serve_tokens_per_sec", "aggregate emitted tokens per second"),
+        "serve_total_tokens": registry.gauge(
+            "serve_total_tokens", "emitted tokens so far"),
+        "serve_mean_slot_occupancy": registry.gauge(
+            "serve_mean_slot_occupancy",
+            "mean fraction of decode slots doing useful work"),
+        "serve_ttft_p50_ms": registry.gauge(
+            "serve_ttft_p50_ms", "time-to-first-token p50"),
+        "serve_ttft_p95_ms": registry.gauge(
+            "serve_ttft_p95_ms", "time-to-first-token p95"),
+        "serve_latency_p95_ms": registry.gauge(
+            "serve_latency_p95_ms", "request latency p95"),
+    }
+    key_map = {"requests_admitted": "serve_requests_admitted",
+               "requests_completed": "serve_requests_completed",
+               "tokens_per_sec": "serve_tokens_per_sec",
+               "total_tokens": "serve_total_tokens",
+               "mean_slot_occupancy": "serve_mean_slot_occupancy",
+               "ttft_p50_ms": "serve_ttft_p50_ms",
+               "ttft_p95_ms": "serve_ttft_p95_ms",
+               "latency_p95_ms": "serve_latency_p95_ms"}
+
+    def collect() -> None:
+        summ = stats.summary()
+        for src, dst in key_map.items():
+            v = summ.get(src)
+            if v is not None:
+                g[dst].set(float(v))
+
+    registry.register_collector(collect)
+
+
+def heartbeat_collector(registry: MetricsRegistry, directory: str) -> None:
+    """Expose heartbeat ages as ``tpujob_heartbeat_age_seconds{rank=...}``
+    — the Grafana stall panel's instant vector (run it wherever the
+    exporter runs with the heartbeat volume mounted, e.g. the watcher)."""
+    import time
+
+    from k8s_distributed_deeplearning_tpu.telemetry import heartbeat as hb
+    age = registry.gauge("tpujob_heartbeat_age_seconds",
+                         "seconds since each rank's last heartbeat",
+                         labelnames=("rank",))
+    step = registry.gauge("tpujob_heartbeat_step",
+                          "last step each rank reported",
+                          labelnames=("rank",))
+
+    def collect() -> None:
+        now = time.time()
+        for rec in hb.read_heartbeats(directory):
+            r = str(rec["rank"])
+            age.labels(rank=r).set(now - float(rec["ts"]))
+            step.labels(rank=r).set(int(rec.get("step", -1)))
+
+    registry.register_collector(collect)

@@ -1,0 +1,42 @@
+"""Golden registry of JSONL event names — the Loki schema contract.
+
+Every ``MetricsLogger.emit(event, ...)`` call in train/, serve/, examples/
+and telemetry/ must use a name listed here. Loki queries and the shipped
+Grafana dashboard select on ``event="..."`` literals; a renamed or ad-hoc
+event silently breaks those panels, so the tier-1 golden-schema test
+(``tests/test_events_schema.py``) scans the source tree for emit sites and
+fails on any name that is not snake_case or not registered below.
+
+Adding an event = adding it here (with a one-line meaning) in the same PR
+as the emit site — the dashboard/query update then has a diff to anchor on.
+"""
+from __future__ import annotations
+
+import re
+
+# name -> one-line meaning (the HELP string of the log plane).
+EVENTS: dict[str, str] = {
+    "start": "run began: world size, step budget, hyperparameters",
+    "restore": "checkpoint restore-on-start; step it resumed from",
+    "train_step": "periodic training step record: loss, step time, "
+                  "throughput, MFU",
+    "eval": "mid-training or final evaluation metrics",
+    "eval_skipped": "an eval cadence point was skipped (and why)",
+    "checkpoint": "a checkpoint write completed",
+    "preempted": "SIGTERM consensus reached; checkpointed and exiting",
+    "serve_request": "one serving request completed: tokens, TTFT, latency",
+    "serve_summary": "end-of-run serving aggregate: tokens/sec, percentiles",
+    "span": "a traced span closed: name, dur_ms, depth, parent, rank",
+    "heartbeat": "per-rank liveness record (also written as heartbeat files)",
+    "stall": "watch flagged a rank with a stale heartbeat",
+}
+
+_SNAKE = re.compile(r"^[a-z][a-z0-9_]*$")
+
+
+def is_snake_case(name: str) -> bool:
+    return bool(_SNAKE.match(name))
+
+
+def known_events() -> frozenset[str]:
+    return frozenset(EVENTS)

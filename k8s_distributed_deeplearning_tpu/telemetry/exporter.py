@@ -1,0 +1,88 @@
+"""Stdlib-threaded HTTP endpoint serving ``/metrics`` and ``/healthz``.
+
+The scrape surface behind the ``prometheus.io/*`` pod annotations that
+``launch/render.py`` stamps on every worker: Prometheus (or a curl) GETs
+``/metrics`` for text-format 0.0.4 exposition of a
+:class:`telemetry.registry.MetricsRegistry`, and K8s probes GET
+``/healthz`` for a JSON liveness answer. ``ThreadingHTTPServer`` on a
+daemon thread: scrapes never block a train step, and the process never
+waits on the exporter to exit.
+
+``port=0`` binds an ephemeral port (tests; ``.port`` reports the choice).
+"""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable
+
+from k8s_distributed_deeplearning_tpu.telemetry.registry import (
+    MetricsRegistry)
+
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+class MetricsExporter:
+    """Serve *registry* on ``http://host:port/metrics``.
+
+    *healthz* is an optional zero-arg callable returning extra fields for
+    the ``/healthz`` JSON body (e.g. heartbeat ages); a raising callable
+    turns the probe into a 503 — wire real liveness conditions there.
+    """
+
+    def __init__(self, registry: MetricsRegistry, *, host: str = "0.0.0.0",
+                 port: int = 9090,
+                 healthz: Callable[[], dict] | None = None):
+        self.registry = registry
+        self.healthz = healthz
+        self._server = ThreadingHTTPServer((host, port), self._handler())
+        self._server.daemon_threads = True
+        self.port = self._server.server_address[1]
+        self._thread: threading.Thread | None = None
+
+    def _handler(self):
+        exporter = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):
+                path = self.path.split("?", 1)[0]
+                if path == "/metrics":
+                    body = exporter.registry.render().encode()
+                    self._reply(200, CONTENT_TYPE, body)
+                elif path == "/healthz":
+                    try:
+                        extra = exporter.healthz() if exporter.healthz else {}
+                        body = json.dumps({"ok": True, **extra}).encode()
+                        self._reply(200, "application/json", body)
+                    except Exception as e:
+                        body = json.dumps({"ok": False,
+                                           "error": repr(e)}).encode()
+                        self._reply(503, "application/json", body)
+                else:
+                    self._reply(404, "text/plain", b"not found\n")
+
+            def _reply(self, code: int, ctype: str, body: bytes) -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args) -> None:
+                pass    # scrapes must not spam the JSONL stdout stream
+
+        return Handler
+
+    def start(self) -> "MetricsExporter":
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        name="metrics-exporter", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None

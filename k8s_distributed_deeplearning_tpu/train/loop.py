@@ -18,12 +18,17 @@ from typing import Any, Callable, Iterator
 import jax
 
 from k8s_distributed_deeplearning_tpu.parallel import distributed
+from k8s_distributed_deeplearning_tpu.telemetry.heartbeat import (
+    HeartbeatWriter)
+from k8s_distributed_deeplearning_tpu.telemetry.trace import Tracer
 from k8s_distributed_deeplearning_tpu.train.checkpoint import Checkpointer
 from k8s_distributed_deeplearning_tpu.train.preemption import PreemptionHandler
 from k8s_distributed_deeplearning_tpu.utils.metrics import MetricsLogger, mfu
 from k8s_distributed_deeplearning_tpu.utils.profiling import StepProfiler
 
 PyTree = Any
+
+_NULL_TRACER = Tracer(enabled=False)
 
 
 def fit(
@@ -44,6 +49,9 @@ def fit(
     profiler: StepProfiler | None = None,
     eval_every: int = 0,
     eval_fn: Callable[[PyTree], dict] | None = None,
+    tracer: Tracer | None = None,
+    heartbeat: HeartbeatWriter | None = None,
+    telemetry: "Any | None" = None,   # telemetry.bridge.TrainTelemetry
 ) -> PyTree:
     """Run synchronous training for ``num_steps``; returns the final state.
 
@@ -72,6 +80,16 @@ def fit(
     (``keep_best_metric=``) each eval also saves a metric-carrying checkpoint
     so the best model — not merely the newest — survives ``max_to_keep``
     (``ModelCheckpoint save_best_only`` parity, ``:160-163``).
+
+    *tracer*: a :class:`telemetry.trace.Tracer` adding the loop's built-in
+    spans — ``data_wait`` (host blocked on the batch source), ``step``
+    (dispatch of the jitted step; async, so this measures host-side cost
+    unless the step blocks) and ``checkpoint`` (save calls). *heartbeat*:
+    a :class:`telemetry.heartbeat.HeartbeatWriter` beaten every step with
+    the current step and the tracer's last-completed span — ``launch
+    watch --heartbeat-dir`` turns a stale file into a named stalled rank.
+    *telemetry*: a :class:`telemetry.bridge.TrainTelemetry` whose gauges
+    update at the ``log_every`` cadence for the ``/metrics`` scrape.
     """
     start_step = 0
     if checkpointer is not None:
@@ -82,6 +100,7 @@ def fit(
                 metrics.emit("restore", step=start_step)
 
     batch_iter = batches(start_step) if callable(batches) else batches
+    tr = tracer if tracer is not None else _NULL_TRACER
     n_dev = jax.device_count()
     t_last = time.monotonic()
     step_last = start_step  # steps actually in the current timing window
@@ -89,9 +108,13 @@ def fit(
     for step in range(start_step, num_steps):
         if profiler is not None:
             profiler.step_hook(step)
-        batch = next(batch_iter)
+        with tr.span("data_wait"):
+            batch = next(batch_iter)
         step_rng = jax.random.fold_in(rng, step)
-        state, loss, aux = step_fn(state, batch, step_rng)
+        with tr.span("step"):
+            state, loss, aux = step_fn(state, batch, step_rng)
+        if heartbeat is not None:
+            heartbeat.beat(step + 1, last_span=tr.last_span)
 
         if preemption is not None:
             # Single process: react immediately on the local flag. Multi-
@@ -105,7 +128,8 @@ def fit(
                         and preemption.agreed())
             if stop:
                 if checkpointer is not None:
-                    checkpointer.save(step + 1, state, force=True)
+                    with tr.span("checkpoint", step=step + 1):
+                        checkpointer.save(step + 1, state, force=True)
                 if metrics:
                     metrics.emit("preempted", step=step + 1,
                                  checkpointed=checkpointer is not None)
@@ -116,7 +140,8 @@ def fit(
         if metrics and log_every and (step + 1) % log_every == 0:
             loss_f = float(loss)  # blocks: this is the host sync point
             now = time.monotonic()
-            dt_ms = (now - t_last) * 1e3 / (step + 1 - step_last)
+            window = step + 1 - step_last
+            dt_ms = (now - t_last) * 1e3 / window
             t_last = now
             step_last = step + 1
             eps = (global_batch_size or 0) / (dt_ms / 1e3) if global_batch_size else 0.0
@@ -128,6 +153,10 @@ def fit(
                 m = mfu(flops_per_example, eps, n_dev, peak_flops)
             metrics.train_step(step + 1, loss_f, dt_ms, eps,
                                eps / n_dev if n_dev else 0.0, mfu=m, **extra)
+            if telemetry is not None:
+                telemetry.on_log(steps_in_window=window, loss=loss_f,
+                                 step_time_ms=dt_ms, examples_per_sec=eps,
+                                 mfu=m)
 
         if eval_fn is not None and eval_every and (step + 1) % eval_every == 0:
             ev = {k: float(v) for k, v in eval_fn(state).items()}
@@ -135,23 +164,32 @@ def fit(
                 metrics.emit("eval", step=step + 1, **ev)
             if (checkpointer is not None
                     and checkpointer.keep_best_metric is not None):
-                checkpointer.save(step + 1, state, metrics=ev)
+                with tr.span("checkpoint", step=step + 1):
+                    checkpointer.save(step + 1, state, metrics=ev)
                 if metrics:
                     metrics.emit("checkpoint", step=step + 1, best_tracked=True)
+                if telemetry is not None:
+                    telemetry.on_checkpoint()
 
         if (checkpointer is not None and checkpoint_every
                 and (step + 1) % checkpoint_every == 0):
-            checkpointer.save(step + 1, state)
+            with tr.span("checkpoint", step=step + 1):
+                checkpointer.save(step + 1, state)
             if metrics:
                 metrics.emit("checkpoint", step=step + 1)
+            if telemetry is not None:
+                telemetry.on_checkpoint()
 
     if profiler is not None:
         profiler.stop()
     if (checkpointer is not None and num_steps > start_step
             and checkpointer.latest_step() != num_steps):
-        checkpointer.save(num_steps, state, force=True)
+        with tr.span("checkpoint", step=num_steps):
+            checkpointer.save(num_steps, state, force=True)
         if metrics:
             metrics.emit("checkpoint", step=num_steps, final=True)
+        if telemetry is not None:
+            telemetry.on_checkpoint()
     return state
 
 

@@ -41,12 +41,20 @@ class MetricsLogger:
         rec = {"event": event, "job": self.job,
                "elapsed_s": round(time.monotonic() - self._t0, 3)}
         for k, v in fields.items():
-            if hasattr(v, "item"):
-                v = v.item()
-            if isinstance(v, float):
-                v = round(v, 6)
+            try:
+                if hasattr(v, "item"):
+                    v = v.item()
+                if isinstance(v, float):
+                    v = round(v, 6)
+            except Exception:
+                # A metric value must never kill a training step: a device
+                # array mid-donation, a lazy object whose .item() raises —
+                # fall through and let the repr fallback below record it.
+                pass
             rec[k] = v
-        line = json.dumps(rec)
+        # default=repr: non-JSON-serializable values degrade to their repr
+        # string instead of raising — the event still lands in Loki.
+        line = json.dumps(rec, default=repr)
         print(line, file=self.stream, flush=True)
         if self._file:
             self._file.write(line + "\n")

@@ -37,3 +37,53 @@ def test_file_sink(tmp_path):
 def test_mfu_math():
     assert m.mfu(1e9, 100.0, 8, 197e12) == (1e9 * 100.0) / (197e12 * 8)
     assert m.mfu(1e9, 100.0, 0, 197e12) == 0.0
+
+
+def test_mfu_degenerate_hardware_is_zero_not_zerodivision():
+    # Zero/negative peak FLOPs (unknown accelerator) and zero devices
+    # (init race) must read as 0.0 utilization, never divide by zero.
+    assert m.mfu(1e9, 100.0, 8, 0.0) == 0.0
+    assert m.mfu(1e9, 100.0, 8, -1.0) == 0.0
+    assert m.mfu(1e9, 100.0, 0, 0.0) == 0.0
+
+
+def test_emit_survives_unserializable_values():
+    """A metric value must never kill a training step: objects that are not
+    JSON-serializable (or whose .item() raises) degrade to repr."""
+    class Hostile:
+        def item(self):
+            raise RuntimeError("buffer donated")
+
+        def __repr__(self):
+            return "<Hostile>"
+
+    buf = io.StringIO()
+    log = m.MetricsLogger(stream=buf, job="t")
+    log.emit("train_step", step=1, weird=Hostile(), data=object())
+    rec = json.loads(buf.getvalue())
+    assert rec["step"] == 1
+    assert rec["weird"] == "<Hostile>"
+    assert rec["data"].startswith("<object object")
+
+
+def test_pct_empty_and_single_sample():
+    assert m.ServingStats._pct([], 0.5) is None
+    assert m.ServingStats._pct([], 0.95) is None
+    # One sample IS every percentile.
+    assert m.ServingStats._pct([7.0], 0.5) == 7.0
+    assert m.ServingStats._pct([7.0], 0.95) == 7.0
+    assert m.ServingStats._pct([7.0], 0.0) == 7.0
+
+
+def test_serving_stats_summary_before_traffic():
+    """summary() on a fresh engine (scraped before the first request) must
+    be well-formed — Nones, not ZeroDivisionError."""
+    s = m.ServingStats().summary()
+    assert s["requests_admitted"] == 0 and s["requests_completed"] == 0
+    assert s["elapsed_s"] == 0.0 and s["total_tokens"] == 0
+    assert s["tokens_per_sec"] is None
+    assert s["mean_slot_occupancy"] is None
+    for k in ("ttft_p50_ms", "ttft_p95_ms", "queue_p50_ms",
+              "latency_p50_ms", "latency_p95_ms"):
+        assert s[k] is None, k
+    json.dumps(s)   # and it serializes straight into the serve_summary event

@@ -71,6 +71,23 @@ def test_chips_per_worker_derived_from_topology():
     assert res["limits"]["google.com/tpu"] == "4"
 
 
+def test_prometheus_scrape_wiring():
+    """Pods advertise their /metrics endpoint the annotation-discovery way:
+    scrape annotations + a named containerPort + TPUJOB_METRICS_PORT env."""
+    cfg = JobConfig(num_workers=2, metrics_port=9464)
+    job = _job(cfg)
+    tmpl = job["spec"]["template"]
+    ann = tmpl["metadata"]["annotations"]
+    assert ann["prometheus.io/scrape"] == "true"
+    assert ann["prometheus.io/port"] == "9464"
+    assert ann["prometheus.io/path"] == "/metrics"
+    container = tmpl["spec"]["containers"][0]
+    ports = {p.get("name"): p["containerPort"] for p in container["ports"]}
+    assert ports["metrics"] == 9464
+    env = {e["name"]: e.get("value") for e in container["env"]}
+    assert env["TPUJOB_METRICS_PORT"] == "9464"
+
+
 def test_deploy_assets_are_valid():
     """Shipped deploy artifacts parse: bash syntax, manifest YAML, dashboard
     JSON — the render-only analog of the reference's smoke-by-deployment."""

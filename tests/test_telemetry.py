@@ -1,0 +1,233 @@
+"""Telemetry subsystem: spans → JSONL, registry → Prometheus exposition,
+heartbeats → stall detection in watch, and the <2% tracing-overhead gate."""
+import io
+import json
+import re
+import threading
+import urllib.request
+
+import pytest
+
+from k8s_distributed_deeplearning_tpu.config import JobConfig
+from k8s_distributed_deeplearning_tpu.launch import watch as watch_mod
+from k8s_distributed_deeplearning_tpu.telemetry import (
+    HeartbeatWriter, MetricsExporter, MetricsRegistry, Tracer, detect_stalls)
+from k8s_distributed_deeplearning_tpu.telemetry import bridge
+from k8s_distributed_deeplearning_tpu.utils.metrics import (
+    MetricsLogger, ServingStats)
+
+
+def _tracer(**kw):
+    buf = io.StringIO()
+    return Tracer(MetricsLogger(stream=buf, job="test"), **kw), buf
+
+
+def _events(buf):
+    return [json.loads(line) for line in buf.getvalue().strip().splitlines()]
+
+
+# ------------------------------------------------------------------ spans
+
+def test_nested_spans_emit_wellformed_jsonl():
+    tr, buf = _tracer(rank=2)
+    with tr.span("step", step=7):
+        with tr.span("data_wait"):
+            pass
+        with tr.span("checkpoint"):
+            pass
+    recs = _events(buf)
+    # Inner spans close (and emit) before the outer one.
+    assert [r["name"] for r in recs] == ["data_wait", "checkpoint", "step"]
+    for r in recs:
+        assert r["event"] == "span" and r["rank"] == 2
+        assert isinstance(r["dur_ms"], float) and r["dur_ms"] >= 0
+    inner, _, outer = recs
+    assert inner["parent"] == "step" and inner["depth"] == 1
+    assert outer["parent"] is None and outer["depth"] == 0
+    assert outer["step"] == 7                 # caller fields ride along
+    assert tr.last_span == "step"
+
+
+def test_disabled_tracer_is_noop():
+    tr, buf = _tracer(enabled=False)
+    with tr.span("step"):
+        pass
+    assert buf.getvalue() == "" and tr.last_span is None
+
+
+def test_min_dur_filter_suppresses_fast_spans():
+    tr, buf = _tracer(min_dur_ms=1e6)
+    with tr.span("step"):
+        pass
+    assert buf.getvalue() == ""
+    assert tr.last_span == "step"             # still tracked for heartbeat
+
+
+def test_span_stacks_are_thread_local():
+    tr, buf = _tracer()
+    inside = threading.Event()
+    release = threading.Event()
+
+    def worker():
+        with tr.span("decode"):
+            inside.set()
+            release.wait(5)
+
+    t = threading.Thread(target=worker)
+    with tr.span("step"):
+        t.start()
+        inside.wait(5)
+        with tr.span("data_wait"):
+            pass
+        release.set()
+        t.join(5)
+    by_name = {r["name"]: r for r in _events(buf)}
+    # The worker's span must not see the main thread's "step" as parent.
+    assert by_name["decode"]["parent"] is None and by_name["decode"]["depth"] == 0
+    assert by_name["data_wait"]["parent"] == "step"
+
+
+# ------------------------------------- Prometheus exposition + exporter
+
+_SAMPLE = re.compile(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{(.*)\})? (.+)$")
+
+
+def _parse_exposition(text):
+    """Minimal Prometheus text-format parser: {(name, frozenset(labels)):
+    value} plus {name: type}."""
+    samples, types = {}, {}
+    for line in text.strip().splitlines():
+        if line.startswith("# TYPE"):
+            _, _, name, kind = line.split(None, 3)
+            types[name] = kind
+            continue
+        if line.startswith("#"):
+            continue
+        m = _SAMPLE.match(line)
+        assert m, f"unparseable exposition line: {line!r}"
+        name, _, labels, value = m.groups()
+        pairs = frozenset(
+            re.findall(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"',
+                       labels or ""))
+        v = float("inf") if value == "+Inf" else float(value)
+        samples[(name, pairs)] = v
+    return samples, types
+
+
+def test_metrics_exposition_roundtrips():
+    reg = MetricsRegistry()
+    reg.counter("train_steps_total", "steps").inc(42)
+    reg.gauge("train_loss", "loss").set(0.125)
+    h = reg.histogram("req_s", "latency", buckets=(0.1, 1.0))
+    for v in (0.05, 0.5, 5.0):
+        h.observe(v)
+    g = reg.gauge("hb_age", "age", labelnames=("rank",))
+    g.labels(rank="0").set(1.5)
+    g.labels(rank="1").set(250.0)
+
+    samples, types = _parse_exposition(reg.render())
+    assert types["train_steps_total"] == "counter"
+    assert types["train_loss"] == "gauge"
+    assert types["req_s"] == "histogram"
+    assert samples[("train_steps_total", frozenset())] == 42
+    assert samples[("train_loss", frozenset())] == 0.125
+    # Histogram: cumulative buckets, +Inf == count, sum adds up.
+    assert samples[("req_s_bucket", frozenset({('le', '0.1')}))] == 1
+    assert samples[("req_s_bucket", frozenset({('le', '1')}))] == 2
+    assert samples[("req_s_bucket", frozenset({('le', '+Inf')}))] == 3
+    assert samples[("req_s_count", frozenset())] == 3
+    assert samples[("req_s_sum", frozenset())] == pytest.approx(5.55)
+    assert samples[("hb_age", frozenset({('rank', '1')}))] == 250.0
+
+
+def test_exporter_serves_metrics_and_healthz():
+    reg = MetricsRegistry()
+    reg.counter("train_steps_total", "steps").inc(3)
+    stats = ServingStats()
+    stats.record_admission(queue_s=0.01, prompt_len=8)
+    stats.record_first_token(ttft_s=0.02)
+    stats.record_step(2, 4)
+    bridge.serving_collector(reg, stats)
+
+    exp = MetricsExporter(reg, host="127.0.0.1", port=0).start()
+    try:
+        base = f"http://127.0.0.1:{exp.port}"
+        body = urllib.request.urlopen(f"{base}/metrics").read().decode()
+        samples, types = _parse_exposition(body)
+        assert samples[("train_steps_total", frozenset())] == 3
+        # The pull-time ServingStats bridge populated the serve gauges.
+        assert samples[("serve_requests_admitted", frozenset())] == 1
+        assert samples[("serve_total_tokens", frozenset())] == 3
+        hz = json.loads(urllib.request.urlopen(f"{base}/healthz").read())
+        assert hz["ok"] is True
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(f"{base}/nope")
+    finally:
+        exp.stop()
+
+
+# --------------------------------------------------- heartbeats + watch
+
+def test_heartbeat_roundtrip_and_stall_detection(tmp_path):
+    d = str(tmp_path)
+    HeartbeatWriter(d, 0, clock=lambda: 1000.0).beat(50, last_span="step")
+    HeartbeatWriter(d, 1, clock=lambda: 700.0).beat(31, last_span="data_wait")
+    stalls = detect_stalls(d, stale_after_s=120.0, now=1010.0)
+    assert [s.rank for s in stalls] == [1]
+    s = stalls[0]
+    assert s.step == 31 and s.last_span == "data_wait"
+    assert s.age_s == pytest.approx(310.0)
+    assert "rank 1" in s.describe() and "data_wait" in s.describe()
+    # Torn/garbage files are skipped, not fatal.
+    (tmp_path / "rank-9.json").write_text("{not json")
+    assert [s.rank for s in detect_stalls(d, 120.0, now=1010.0)] == [1]
+
+
+def test_watch_flags_stalled_rank(tmp_path):
+    """A hung rank (stale heartbeat) is reported BY RANK ID with its last
+    span while healthy ranks stay unreported — and the stall is emitted
+    once, not once per poll."""
+    from tests.test_watch import FakeCluster
+
+    d = str(tmp_path)
+    now = {"t": 1000.0}
+    HeartbeatWriter(d, 0, clock=lambda: now["t"]).beat(50, last_span="step")
+    HeartbeatWriter(d, 1, clock=lambda: 500.0).beat(12,
+                                                    last_span="data_wait")
+
+    cfg = JobConfig(num_workers=2)
+    cluster = FakeCluster([
+        {"active": 2, "succeeded": 0},
+        {"active": 2, "succeeded": 0},
+        {"active": 0, "succeeded": 2},
+    ])
+    events = []
+    fake = {"t": 0.0}
+    result = watch_mod.watch(
+        cfg, kubectl=watch_mod.Kubectl(runner=cluster.runner),
+        clock=lambda: fake["t"],
+        sleep=lambda dt: fake.__setitem__("t", fake["t"] + dt),
+        poll_interval=1.0, attempt_timeout=100.0,
+        on_event=events.append,
+        heartbeat_dir=d, heartbeat_stale_after=120.0,
+        heartbeat_clock=lambda: now["t"])
+    assert result.status.succeeded == 2
+    stall_events = [e for e in events if "stalled" in e]
+    assert len(stall_events) == 1, events       # reported once, not per poll
+    assert "rank 1" in stall_events[0]
+    assert "data_wait" in stall_events[0]       # last-completed span named
+    assert not any("rank 0" in e for e in stall_events)
+
+
+# ------------------------------------------------------- overhead gate
+
+def test_tracing_overhead_under_two_percent():
+    """bench.py --suite telemetry: the loop's built-in spans (JSONL emit
+    included) must cost <2% of mean step time on the CPU config."""
+    import bench
+
+    out = bench.measure_telemetry_overhead(steps=12, warmup=3,
+                                           batch_size=256, repeats=2)
+    assert out["step_ms_plain"] > 0 and out["step_ms_traced"] > 0
+    assert out["spans_emitted_last_window"] == 2 * 12   # data_wait + step
+    assert out["telemetry_overhead_pct"] < 2.0, out

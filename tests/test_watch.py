@@ -113,6 +113,90 @@ def test_watch_exhausts_restarts():
         _watch(cluster, cfg, attempt_timeout=100.0, max_restarts=2)
 
 
+class FlakyRunner:
+    """Scripted runner for retry tests: pops one (rc, out, err) — or an
+    exception instance to raise — per call, recording each attempt."""
+
+    def __init__(self, script):
+        self.script = list(script)
+        self.attempts = 0
+
+    def __call__(self, args, input_text):
+        self.attempts += 1
+        step = self.script.pop(0)
+        if isinstance(step, Exception):
+            raise step
+        return step
+
+
+def _kubectl(runner, **kw):
+    sleeps = []
+    k = watch_mod.Kubectl(runner=runner, sleep=sleeps.append, **kw)
+    return k, sleeps
+
+
+def test_kubectl_retries_transient_failures_with_backoff():
+    """Two apiserver blips (nonzero rc + timeout-ish stderr), then success:
+    the verb succeeds and the waits grow exponentially from backoff_s."""
+    runner = FlakyRunner([
+        (1, "", "Unable to connect to the server: dial tcp: i/o timeout"),
+        (1, "", "Unable to connect to the server: connection refused"),
+        (0, json.dumps({"status": {"active": 1}}), ""),
+    ])
+    k, sleeps = _kubectl(runner, retries=2, backoff_s=1.0)
+    status = k.job_status(JobConfig(num_workers=1))
+    assert status.exists and status.active == 1
+    assert runner.attempts == 3
+    assert sleeps == [1.0, 2.0]
+
+
+def test_kubectl_retries_raised_timeouts():
+    """A surfaced subprocess timeout (RuntimeError '... timed out ...') is
+    transient too — retried, not fatal."""
+    runner = FlakyRunner([
+        RuntimeError("kubectl get job timed out after 120.0s"),
+        (0, json.dumps({"status": {"succeeded": 1}}), ""),
+    ])
+    k, sleeps = _kubectl(runner, retries=2, backoff_s=0.5)
+    assert k.job_status(JobConfig(num_workers=1)).succeeded == 1
+    assert runner.attempts == 2 and sleeps == [0.5]
+
+
+def test_kubectl_does_not_retry_permanent_errors():
+    """Forbidden/NotFound/bad-manifest must surface on the FIRST attempt —
+    retrying a broken config just delays the operator's diagnosis."""
+    runner = FlakyRunner([
+        (1, "", 'jobs.batch is forbidden: User "x" cannot get resource'),
+    ])
+    k, sleeps = _kubectl(runner, retries=3, backoff_s=1.0)
+    with pytest.raises(RuntimeError, match="forbidden"):
+        k.job_status(JobConfig(num_workers=1))
+    assert runner.attempts == 1 and sleeps == []
+
+    kaboom = FlakyRunner([RuntimeError("kubectl not found on PATH — ...")])
+    k2, sleeps2 = _kubectl(kaboom, retries=3)
+    with pytest.raises(RuntimeError, match="not found on PATH"):
+        k2._run_kubectl(["get", "job", "x"])
+    assert kaboom.attempts == 1 and sleeps2 == []
+
+
+def test_kubectl_retry_budget_is_bounded():
+    """retries=2 means at most 3 attempts; the last transient error is
+    returned (rc path) or raised (exception path), never looped forever."""
+    always_down = FlakyRunner(
+        [(1, "", "connection refused")] * 3)
+    k, sleeps = _kubectl(always_down, retries=2, backoff_s=1.0)
+    rc, _, err = k._run_kubectl(["get", "job", "x"])
+    assert rc == 1 and "connection refused" in err
+    assert always_down.attempts == 3 and sleeps == [1.0, 2.0]
+
+    raising = FlakyRunner([RuntimeError("request timed out")] * 2)
+    k2, _ = _kubectl(raising, retries=1)
+    with pytest.raises(RuntimeError, match="timed out"):
+        k2._run_kubectl(["get", "job", "x"])
+    assert raising.attempts == 2
+
+
 def test_watch_missing_job_is_not_complete():
     """A deleted-out-from-under-us Job reads as not-exists (NotFound) and
     ends in reconcile, not a crash."""
