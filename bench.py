@@ -684,6 +684,133 @@ def measure_telemetry_overhead(steps: int = 30, warmup: int = 5,
     }
 
 
+_RECOVERY_WORKER = '''\
+"""Recovery-bench worker: tiny train run that logs wall-clock step events
+to a shared file, so the parent can time kill -> first post-restore step
+across process incarnations."""
+import json, os, sys, time
+
+workdir = sys.argv[1]
+attempt = int(os.environ.get("TPUJOB_ATTEMPT", "0"))
+_evf = open(os.path.join(workdir, "events.jsonl"), "a")
+
+
+def ev(name, **kw):
+    _evf.write(json.dumps(
+        {"event": name, "ts": time.time(), "attempt": attempt, **kw}) + "\\n")
+    _evf.flush()
+
+
+ev("boot")
+import jax
+import jax._src.xla_bridge as _xb
+_xb._backend_factories.pop("axon", None)   # force CPU (conftest pattern)
+jax.config.update("jax_platform_name", "cpu")
+import jax.numpy as jnp, optax
+from k8s_distributed_deeplearning_tpu.models import mnist
+from k8s_distributed_deeplearning_tpu.train import data as data_lib
+from k8s_distributed_deeplearning_tpu.train import loop as train_loop
+from k8s_distributed_deeplearning_tpu.train.checkpoint import Checkpointer
+
+model = mnist.MNISTConvNet(dtype=jnp.float32)
+rng = jax.random.key(0)
+params = model.init(rng, jnp.zeros((1, 28, 28, 1)), train=False)["params"]
+opt = optax.adam(1e-3)
+
+
+@jax.jit
+def step(state, batch, step_rng):
+    p, opt_state = state
+    (loss, aux), grads = jax.value_and_grad(
+        lambda q: mnist.loss_fn(model, q, batch, step_rng),
+        has_aux=True)(p)
+    updates, opt_state = opt.update(grads, opt_state, p)
+    return (optax.apply_updates(p, updates), opt_state), loss, aux
+
+
+x, y = data_lib.synthetic_mnist(64, seed=0)
+batch = {"image": x, "label": y}
+
+
+def batches(start_step):
+    def gen():
+        s = start_step
+        while True:
+            ev("step", step=s)
+            yield batch
+            s += 1
+    return gen()
+
+
+ckpt = Checkpointer(os.path.join(workdir, "ckpt"))
+state = train_loop.fit(step, (params, opt.init(params)), batches,
+                       int(os.environ["BENCH_NUM_STEPS"]), rng,
+                       checkpointer=ckpt, checkpoint_every=2, log_every=0)
+jax.block_until_ready(state)
+ckpt.close()
+ev("done")
+'''
+
+
+def measure_recovery(num_steps: int = 10, kill_at_step: int = 5) -> dict:
+    """Crash-recovery wall-clock: a 1-worker CPU gang under ``run_elastic``
+    is hard-killed (fault plan: ``os._exit`` at step *kill_at_step*,
+    attempt 0 only) and restarts; the recovery time is from the last step
+    the dying incarnation started to the first step the restarted one
+    started — process death, relaunch, jax init, recompile, and the
+    checkpoint restore all inside the window. The backing run is the real
+    path: ``train.loop.fit`` + Orbax ``Checkpointer`` + the fault-injection
+    hooks, driven by the same executor the chaos tests use."""
+    import tempfile
+
+    from k8s_distributed_deeplearning_tpu.config import JobConfig
+    from k8s_distributed_deeplearning_tpu.launch.elastic import run_elastic
+
+    with tempfile.TemporaryDirectory() as workdir:
+        script = os.path.join(workdir, "worker.py")
+        with open(script, "w") as f:
+            f.write(_RECOVERY_WORKER)
+        plan = json.dumps({"faults": [{
+            "site": "step", "action": "exit", "step": kill_at_step,
+            "attempt": 0, "exit_code": 43}]})
+        cfg = JobConfig(name="bench-recovery", num_workers=1,
+                        script=script, script_args=[workdir])
+        env = {
+            "JAX_PLATFORM_NAME": "cpu",
+            "JAX_COMPILATION_CACHE_DIR":
+                os.environ.get("JAX_COMPILATION_CACHE_DIR", ""),
+            # the worker script lives in a tempdir, not under the repo
+            "PYTHONPATH": REPO,
+            "TPUJOB_FAULT_PLAN": plan,
+            "BENCH_NUM_STEPS": str(num_steps),
+        }
+        t0 = time.perf_counter()
+        _, restarts = run_elastic(
+            cfg, extra_env=env, timeout=600, cwd=REPO, max_restarts=2,
+            checkpoint_dir=os.path.join(workdir, "ckpt"))
+        total_s = time.perf_counter() - t0
+        events = []
+        with open(os.path.join(workdir, "events.jsonl")) as f:
+            for line in f:
+                events.append(json.loads(line))
+    steps0 = [e for e in events if e["event"] == "step" and e["attempt"] == 0]
+    steps1 = [e for e in events if e["event"] == "step" and e["attempt"] == 1]
+    if not steps0 or not steps1:
+        raise RuntimeError(f"recovery bench saw no restart (restarts="
+                           f"{restarts}; events={len(events)})")
+    recovery_s = steps1[0]["ts"] - steps0[-1]["ts"]
+    return {
+        "recovery_s": round(recovery_s, 3),
+        "killed_at_step": kill_at_step,
+        "resumed_from_step": steps1[0]["step"],
+        "steps_replayed": max(0, steps0[-1]["step"] - steps1[0]["step"] + 1),
+        "restarts": restarts,
+        "total_run_s": round(total_s, 3),
+        "config": {"num_steps": num_steps, "checkpoint_every": 2,
+                   "platform": "cpu (1-worker local gang)"},
+    }
+
+
 def measure_attention(seq_lens=(1024, 2048, 4096), steps: int = 20,
                       warmup: int = 3) -> dict:
     """Flash (Pallas) vs XLA attention, fwd and fwd+bwd, causal, bf16,
@@ -795,7 +922,8 @@ def main() -> None:
     ap.add_argument("--batch-size", type=int, default=16384)
     ap.add_argument("--suite",
                     choices=["all", "mnist", "llama", "attention", "zoo",
-                             "decode", "moe", "serve", "telemetry"],
+                             "decode", "moe", "serve", "telemetry",
+                             "recovery"],
                     default="all")
     ap.add_argument("--cpu-baseline", action="store_true",
                     help="internal: measure the CPU reference stand-in")
@@ -859,6 +987,15 @@ def main() -> None:
             "metric": "telemetry_overhead_pct",
             "value": extra["telemetry_overhead_pct"],
             "unit": "% of mean step time (tracing on vs off)",
+            "vs_baseline": None,
+            "extra": extra})
+        return
+    if args.suite == "recovery":
+        extra = measure_recovery()
+        emit({
+            "metric": "recovery_s",
+            "value": extra["recovery_s"],
+            "unit": "s from last pre-kill step to first post-restore step",
             "vs_baseline": None,
             "extra": extra})
         return

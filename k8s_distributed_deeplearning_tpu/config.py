@@ -116,6 +116,10 @@ class JobConfig:
     metrics_port: int = 9090         # Prometheus /metrics (+ /healthz) scrape
     clean_pod_policy: str = "Running"  # tensorflow-mnist.yaml:8
     tpu_chips_per_worker: int | None = None  # None -> derived from topology
+    # Optional fault-injection plan carried into every worker as
+    # $TPUJOB_FAULT_PLAN (inline JSON, or "@/path" to a mounted file) —
+    # the chaos-test rendering path (faults/plan.py). None renders no env.
+    fault_plan: str | None = None
 
     def chips_per_worker(self) -> int:
         """TPU chips each pod must request: the slice's chip total (product of

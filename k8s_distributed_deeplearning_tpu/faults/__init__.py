@@ -1,0 +1,21 @@
+"""Deterministic fault injection — the chaos harness behind tests/test_faults.py.
+
+This package is deliberately jax-free: ``launch/`` (which must import
+without jax for manifest rendering/validation) validates fault plans, and
+worker processes read theirs from ``$TPUJOB_FAULT_PLAN`` before jax is up.
+"""
+
+from k8s_distributed_deeplearning_tpu.faults.inject import (  # noqa: F401
+    ATTEMPT_ENV,
+    FAULT_PLAN_ENV,
+    FaultInjector,
+    activate,
+    active,
+    deactivate,
+)
+from k8s_distributed_deeplearning_tpu.faults.plan import (  # noqa: F401
+    ACTIONS,
+    SITES,
+    Fault,
+    FaultPlan,
+)

@@ -1,0 +1,165 @@
+"""Declarative fault plans: WHAT fails, WHERE, and WHEN — as data.
+
+A :class:`FaultPlan` is a JSON-serializable list of :class:`Fault` records.
+It travels as the ``TPUJOB_FAULT_PLAN`` env var (inline JSON, or ``@/path``
+to a JSON file) so the exact same chaos scenario runs in-process under
+pytest, under the local gang executor, and on a real cluster through the
+rendered manifest (``JobConfig.fault_plan`` → ``launch/render.py``).
+
+Determinism is the whole point: every fault names its trigger exactly —
+the site (a named hook point in the code), the rank, the step or visit
+count, and the attempt (restart number) it fires on. Two runs of the same
+plan inject identically, which is what lets ``tests/test_faults.py`` prove
+"recovers to a final state consistent with the unfaulted run" rather than
+"usually survives some noise".
+
+Sites (where the hook points live):
+
+- ``step``             train loop, start of each step (``train/loop.py``)
+- ``data_wait``        train loop, before pulling the next batch
+- ``shard_read``       ``TokenShardBatcher._make_batch`` (``train/data.py``)
+- ``checkpoint_saved`` train loop, right after a checkpoint write completes
+- ``heartbeat``        gates ``HeartbeatWriter.beat`` in the train loop
+- ``serve_decode``     serving engine, before each decode iteration
+- ``executor``         the PARENT gang executor (``launch/local_executor``):
+                       kills worker *rank* from outside after *seconds* —
+                       the kubelet/node-failure emulation
+
+Actions (what happens when the trigger matches):
+
+- ``exit``     ``os._exit(exit_code)`` — a hard kill, no cleanup, no
+               atexit, the SIGKILL-equivalent from inside
+- ``sigterm``  ``os.kill(os.getpid(), SIGTERM)`` — the K8s eviction signal
+- ``stall``    ``time.sleep(seconds)`` — a hung data source / slow volume
+- ``ioerror``  raise ``OSError`` (transient: fires ``count`` times after
+               ``after`` visits, then stops — the retryable-blip shape)
+- ``truncate`` truncate the largest file of the newest checkpoint step
+               under the hook's path (torn mid-write)
+- ``corrupt``  flip bytes of that file, size-preserving (bitrot/bad DMA)
+- ``stop``     suppress the hooked side effect from ``step`` onward
+               (heartbeat writer goes silent — the zombie-rank mode)
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+
+SITES = ("step", "data_wait", "shard_read", "checkpoint_saved", "heartbeat",
+         "serve_decode", "executor")
+ACTIONS = ("exit", "sigterm", "stall", "ioerror", "truncate", "corrupt",
+           "stop")
+
+# Which actions make sense at which sites — a plan naming a nonsensical
+# pair is a bug in the scenario, not a scenario.
+_SITE_ACTIONS = {
+    "step": ("exit", "sigterm", "stall"),
+    "data_wait": ("stall", "ioerror", "exit", "sigterm"),
+    "shard_read": ("ioerror", "stall"),
+    "checkpoint_saved": ("truncate", "corrupt", "exit"),
+    "heartbeat": ("stop",),
+    "serve_decode": ("stall", "exit", "sigterm"),
+    "executor": ("exit", "sigterm"),
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class Fault:
+    """One injected failure. Fields not relevant to the action keep their
+    defaults (validation rejects contradictory combinations).
+
+    ``rank``: which process fires (None = every rank). ``step``: fire when
+    the hook's step equals this (None = fire by visit count instead:
+    skip the first ``after`` visits, then fire ``count`` times).
+    ``attempt``: which restart incarnation fires (0 = the first run only —
+    the default, so a kill-fault doesn't re-kill the recovered job forever;
+    None = every attempt). ``seconds`` feeds ``stall`` and the ``executor``
+    kill delay; ``exit_code`` feeds ``exit``.
+    """
+
+    site: str
+    action: str
+    rank: int | None = None
+    step: int | None = None
+    after: int = 0
+    count: int = 1
+    seconds: float = 0.0
+    exit_code: int = 43
+    attempt: int | None = 0
+
+    def problems(self) -> list[str]:
+        errs = []
+        if self.site not in SITES:
+            errs.append(f"unknown site {self.site!r} (one of {SITES})")
+        if self.action not in ACTIONS:
+            errs.append(f"unknown action {self.action!r} (one of {ACTIONS})")
+        if not errs and self.action not in _SITE_ACTIONS[self.site]:
+            errs.append(f"action {self.action!r} is not valid at site "
+                        f"{self.site!r} (valid: {_SITE_ACTIONS[self.site]})")
+        if self.action == "stall" and self.seconds <= 0:
+            errs.append("stall needs seconds > 0")
+        if self.site == "executor":
+            if self.rank is None:
+                errs.append("executor faults must name a rank (the victim)")
+            if self.step is not None:
+                errs.append("executor faults are delay-based (seconds), "
+                            "not step-based")
+        if self.count < 1:
+            errs.append(f"count must be >= 1, got {self.count}")
+        if self.after < 0:
+            errs.append(f"after must be >= 0, got {self.after}")
+        if self.rank is not None and self.rank < 0:
+            errs.append(f"rank must be >= 0, got {self.rank}")
+        return errs
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultPlan:
+    """An ordered collection of faults, serializable to/from JSON."""
+
+    faults: tuple[Fault, ...] = ()
+
+    def to_json(self) -> str:
+        return json.dumps({"faults": [dataclasses.asdict(f)
+                                      for f in self.faults]})
+
+    @classmethod
+    def from_json(cls, text: str) -> "FaultPlan":
+        try:
+            doc = json.loads(text)
+        except json.JSONDecodeError as e:
+            raise ValueError(f"fault plan is not valid JSON: {e}") from e
+        if not isinstance(doc, dict) or not isinstance(doc.get("faults"),
+                                                       list):
+            raise ValueError(
+                'fault plan must be {"faults": [...]}, got '
+                f"{type(doc).__name__}")
+        known = {f.name for f in dataclasses.fields(Fault)}
+        faults = []
+        for i, rec in enumerate(doc["faults"]):
+            if not isinstance(rec, dict):
+                raise ValueError(f"faults[{i}] is not an object")
+            unknown = set(rec) - known
+            if unknown:
+                raise ValueError(
+                    f"faults[{i}] has unknown fields {sorted(unknown)} "
+                    f"(known: {sorted(known)})")
+            try:
+                faults.append(Fault(**rec))
+            except TypeError as e:
+                raise ValueError(f"faults[{i}]: {e}") from e
+        return cls(faults=tuple(faults))
+
+    def problems(self) -> list[str]:
+        """Validation errors (empty = plan is well-formed). Used by
+        ``launch/validate.py`` so a bad plan fails at render time, not
+        half an hour into the chaos run."""
+        errs: list[str] = []
+        for i, f in enumerate(self.faults):
+            errs.extend(f"faults[{i}]: {p}" for p in f.problems())
+        return errs
+
+    def validate_or_raise(self) -> "FaultPlan":
+        errs = self.problems()
+        if errs:
+            raise ValueError("invalid fault plan:\n  " + "\n  ".join(errs))
+        return self

@@ -23,11 +23,13 @@ would fail the real Job.
 from __future__ import annotations
 
 import os
+import signal
 import socket
 import subprocess
 import sys
 from dataclasses import dataclass
 
+from k8s_distributed_deeplearning_tpu import faults as _faults
 from k8s_distributed_deeplearning_tpu.config import JobConfig
 from k8s_distributed_deeplearning_tpu.launch import render, validate
 
@@ -62,13 +64,68 @@ def _resolve_env(container_env: list[dict], index: int) -> dict[str, str]:
     return out
 
 
+def _executor_fault_threads(container_env: list[dict],
+                            extra_env: dict[str, str] | None,
+                            attempt: int, procs: list) -> list:
+    """Parent-side ``executor`` faults: the manifest (or overlay) names a
+    fault plan, and faults with ``site: executor`` model the KILLER BEING
+    OUTSIDE the worker — the kubelet OOM-killing a pod, a node reclaim —
+    so they run here in the launcher, as timers that signal the victim
+    rank. Worker-internal sites (step, data_wait, ...) ride the env into
+    the children instead. Returns the started timer threads (daemon)."""
+    import threading
+
+    raw = (extra_env or {}).get(_faults.FAULT_PLAN_ENV)
+    if raw is None:
+        for e in container_env:
+            if e.get("name") == _faults.FAULT_PLAN_ENV:
+                raw = e.get("value")
+    raw = (raw or "").strip()
+    if not raw:
+        return []
+    if raw.startswith("@"):
+        with open(raw[1:]) as f:
+            raw = f.read()
+    plan = _faults.FaultPlan.from_json(raw)
+    threads = []
+    for f in plan.faults:
+        if f.site != "executor":
+            continue
+        if f.attempt is not None and f.attempt != attempt:
+            continue
+        sig = signal.SIGKILL if f.action == "exit" else signal.SIGTERM
+        victim = procs[f.rank]
+
+        def kill(victim=victim, sig=sig, delay=f.seconds, rank=f.rank):
+            import time as _time
+            _time.sleep(delay)
+            if victim.poll() is None:
+                print(f"fault-injection: executor sends signal {sig} to "
+                      f"rank {rank} (pid {victim.pid})",
+                      file=sys.stderr, flush=True)
+                try:
+                    victim.send_signal(sig)
+                except OSError:
+                    pass
+        t = threading.Thread(target=kill, daemon=True)
+        t.start()
+        threads.append(t)
+    return threads
+
+
 def run_local(cfg: JobConfig, *, extra_env: dict[str, str] | None = None,
-              timeout: int = 600, cwd: str | None = None) -> list[WorkerResult]:
+              timeout: int = 600, cwd: str | None = None,
+              attempt: int = 0) -> list[WorkerResult]:
     """Execute the job's pod template locally, one process per index.
 
     *extra_env* overlays the manifest env (e.g. forcing the CPU backend for
     CI). Returns per-worker results; raises on validation errors before
     anything is spawned — the same fail-fast a server-side dry-run gives.
+
+    *attempt* is the restart incarnation (0 on the first run); it is
+    stamped into each worker as ``$TPUJOB_ATTEMPT`` so attempt-scoped
+    faults don't re-fire after the restart they caused — the mechanism
+    that lets one plan express "kill once at step 3, then run clean".
     """
     docs = render.render_all(cfg)
     validate.validate_or_raise(docs)
@@ -92,9 +149,12 @@ def run_local(cfg: JobConfig, *, extra_env: dict[str, str] | None = None,
         # The one cluster-vs-local substitution (see module docstring).
         env["TPUJOB_COORDINATOR_ADDRESS"] = f"127.0.0.1:{port}"
         env.update(extra_env or {})
+        env[_faults.ATTEMPT_ENV] = str(attempt)
         procs.append(subprocess.Popen(
             cmd, env=env, cwd=cwd, text=True,
             stdout=subprocess.PIPE, stderr=subprocess.PIPE))
+
+    _executor_fault_threads(container["env"], extra_env, attempt, procs)
 
     # Drain every worker's pipes CONCURRENTLY: sequential communicate()
     # would deadlock the gang when a later worker fills its 64KiB pipe

@@ -65,6 +65,10 @@ def render_tpujob(cfg: JobConfig) -> dict:
         # prometheus.io/port scrape annotation below).
         {"name": "TPUJOB_METRICS_PORT", "value": str(cfg.metrics_port)},
     ]
+    if cfg.fault_plan:
+        # Chaos-test runs carry their fault plan in the manifest itself,
+        # so the rendered object fully describes the experiment.
+        env.append({"name": "TPUJOB_FAULT_PLAN", "value": cfg.fault_plan})
     container = {
         "name": "worker",
         "image": cfg.image,

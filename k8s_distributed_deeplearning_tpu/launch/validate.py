@@ -21,6 +21,8 @@ import re
 import shutil
 import subprocess
 
+from k8s_distributed_deeplearning_tpu.faults.plan import FaultPlan
+
 _RFC1123 = re.compile(r"^[a-z0-9]([a-z0-9-]{0,251}[a-z0-9])?$")
 # K8s resource.Quantity (the practical subset: plain/decimal-SI/binary-SI).
 _QUANTITY = re.compile(r"^[0-9]+(\.[0-9]+)?(m|k|M|G|T|P|Ki|Mi|Gi|Ti|Pi)?$")
@@ -67,6 +69,25 @@ def _check_container(errors, where: str, c: dict) -> None:
                 _err(errors, where,
                      f"{kind}.{res} quantity {qty!r} is not a valid "
                      "Kubernetes resource quantity")
+    _check_fault_plan(errors, where, c)
+
+
+def _check_fault_plan(errors, where: str, c: dict) -> None:
+    """A manifest carrying $TPUJOB_FAULT_PLAN must carry a VALID plan —
+    a typo'd plan silently not firing would pass a chaos run vacuously.
+    ``@/path`` values are structural (the file lives in the container's
+    filesystem, not here), so only inline JSON is parsed."""
+    for e in c.get("env", []):
+        if e.get("name") != "TPUJOB_FAULT_PLAN" or "value" not in e:
+            continue
+        raw = (e.get("value") or "").strip()
+        if not raw or raw.startswith("@"):
+            continue
+        try:
+            FaultPlan.from_json(raw).validate_or_raise()
+        except (ValueError, TypeError) as ex:
+            _err(errors, where, f"TPUJOB_FAULT_PLAN is not a valid fault "
+                 f"plan: {ex}")
 
 
 def validate(docs: list[dict]) -> list[str]:

@@ -42,6 +42,8 @@ from k8s_distributed_deeplearning_tpu.launch.elastic import (  # noqa: F401
     resize_to,
 )
 from k8s_distributed_deeplearning_tpu.telemetry import heartbeat as hb
+from k8s_distributed_deeplearning_tpu.utils.ckpt import latest_step_on_disk
+from k8s_distributed_deeplearning_tpu.utils.retry import retry_transient
 
 # Stderr substrings marking a kubectl failure as transient — an apiserver
 # blip worth retrying, not a config error worth surfacing.
@@ -54,6 +56,17 @@ _TRANSIENT_MARKERS = ("timed out", "timeout", "connection refused",
 def _is_transient(text: str) -> bool:
     low = text.lower()
     return any(m in low for m in _TRANSIENT_MARKERS)
+
+
+class _TransientRC(Exception):
+    """Internal: a non-zero kubectl exit whose stderr looks transient,
+    wrapped as an exception so ``utils.retry.retry_transient`` drives the
+    backoff; the final attempt's payload is unwrapped back to (rc, out,
+    err) — callers keep seeing return codes, never this type."""
+
+    def __init__(self, rc: int, out: str, err: str):
+        super().__init__(err)
+        self.result = (rc, out, err)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -117,22 +130,26 @@ class Kubectl:
             return self._runner(args, input_text)
 
     def _run_kubectl(self, args, input_text=None, timeout=120.0):
-        """Run one kubectl verb with bounded transient-failure retry."""
-        delay = self.backoff_s
-        for attempt in range(self.retries + 1):
-            last = attempt == self.retries
-            try:
-                rc, out, err = self._call_runner(args, input_text, timeout)
-            except RuntimeError as e:
-                # kubectl-not-found is permanent; surfaced timeouts retry.
-                if last or not _is_transient(str(e)):
-                    raise
-            else:
-                if rc == 0 or last or not _is_transient(err):
-                    return rc, out, err
-            self._sleep(delay)
-            delay *= 2
-        raise AssertionError("unreachable")
+        """Run one kubectl verb with bounded transient-failure retry
+        (the shared ``utils.retry`` policy; kubectl-not-found and other
+        permanent errors surface on the first attempt)."""
+        def attempt():
+            rc, out, err = self._call_runner(args, input_text, timeout)
+            if rc != 0 and _is_transient(err):
+                raise _TransientRC(rc, out, err)
+            return rc, out, err
+
+        try:
+            return retry_transient(
+                attempt, retries=self.retries, backoff_s=self.backoff_s,
+                sleep=self._sleep,
+                # Surfaced kubectl timeouts (RuntimeError) retry too.
+                is_transient=lambda e: isinstance(e, _TransientRC) or (
+                    isinstance(e, RuntimeError) and _is_transient(str(e))))
+        except _TransientRC as e:
+            # Still failing transiently after the last retry: hand the
+            # final (rc, out, err) back for the caller's own error path.
+            return e.result
 
     def apply(self, text: str) -> None:
         rc, _, err = self._run_kubectl(["apply", "-f", "-"], text)
@@ -188,7 +205,10 @@ def watch(cfg: JobConfig, *,
           sleep: Callable[[float], None] = time.sleep,
           heartbeat_dir: str | None = None,
           heartbeat_stale_after: float = 120.0,
-          heartbeat_clock: Callable[[], float] = time.time) -> WatchResult:
+          heartbeat_clock: Callable[[], float] = time.time,
+          checkpoint_dir: str | None = None,
+          min_progress_steps: int = 1,
+          crash_loop_after: int = 3) -> WatchResult:
     """Reconcile the gang against the cluster until it completes.
 
     Each ATTEMPT applies the rendered objects (validated first — the
@@ -210,11 +230,23 @@ def watch(cfg: JobConfig, *,
     hung-collective mode becomes a NAMED diagnosis minutes in, rather than
     an anonymous attempt timeout half an hour later. Ranks are re-reported
     only after recovering (fresh heartbeat) and stalling again.
+
+    *checkpoint_dir*: enables crash-loop detection over the shared
+    checkpoint volume (same contract as ``run_elastic``): a reconcile
+    whose attempt advanced the newest on-disk step by fewer than
+    *min_progress_steps* counts as no-progress; *crash_loop_after*
+    consecutive no-progress reconciles abort the watch with a
+    ``crash_loop`` event naming the dead attempts' Job statuses, instead
+    of burning the restart budget replaying a deterministic death.
     """
     kubectl = kubectl or Kubectl()
     emit = on_event or (lambda _msg: None)
     restarts = 0
     stalled_ranks: set[int] = set()     # currently-reported stalls
+    no_progress = 0
+    loop_statuses: list[str] = []
+    last_ckpt_step = (latest_step_on_disk(checkpoint_dir)
+                      if checkpoint_dir else None)
 
     def check_heartbeats() -> None:
         if heartbeat_dir is None:
@@ -261,6 +293,25 @@ def watch(cfg: JobConfig, *,
                  f"(active={status.active}, succeeded={status.succeeded})"
                  " — treating the gang as broken")
         restarts += 1
+        if checkpoint_dir is not None:
+            step = latest_step_on_disk(checkpoint_dir)
+            advanced = (step or 0) - (last_ckpt_step or 0)
+            last_ckpt_step = step
+            desc = (f"failed={status.failed} job_failed={status.job_failed}"
+                    f" active={status.active}")
+            if advanced < min_progress_steps:
+                no_progress += 1
+                loop_statuses.append(desc)
+            else:
+                no_progress = 0
+                loop_statuses = []
+            if no_progress >= crash_loop_after:
+                msg = (f"crash_loop: {no_progress} consecutive attempts "
+                       f"died with <{min_progress_steps} checkpointed "
+                       f"step(s) of progress (latest step: {step}); "
+                       f"attempts: {loop_statuses}")
+                emit(msg)
+                raise RuntimeError(msg)
         if restarts > max_restarts:
             raise RuntimeError(
                 f"gang failed {restarts} attempts (last status: "

@@ -43,6 +43,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from k8s_distributed_deeplearning_tpu import faults as _faults
 from k8s_distributed_deeplearning_tpu.models import generate
 from k8s_distributed_deeplearning_tpu.serve.request import (
     Request, RequestOutput)
@@ -242,17 +243,37 @@ class ServeEngine:
         """One serving iteration: admit queued requests into free slots,
         then advance every occupied slot one token. Returns the requests
         that finished during this iteration (possibly at admission, when
-        the first token is already EOS or ``max_new_tokens == 1``)."""
+        the first token is already EOS or ``max_new_tokens == 1``).
+
+        Deadline enforcement happens here, at the decode boundary: an
+        occupied slot whose request's ``deadline_s`` has expired is
+        cancelled FIRST (finish_reason "timeout", slot freed — so the
+        admission pass below can reuse it this very iteration), and an
+        expired request popped from the queue completes as "timeout"
+        without ever prefilling. A hung client therefore costs at most
+        one decode iteration of slot time past its own budget, and never
+        stalls the other slots."""
         outputs: list[RequestOutput] = []
+        now = time.perf_counter()
+        for slot, fl in enumerate(self._slots):
+            if fl is not None and self._expired(fl.req, now):
+                outputs.append(self._finish(slot, "timeout"))
         for slot in range(self.num_slots):
             while self._slots[slot] is None and len(self.queue):
-                done = self._admit(slot, self.queue.pop())
+                req = self.queue.pop()
+                if self._expired(req, time.perf_counter()):
+                    outputs.append(self._timeout_unadmitted(req))
+                    continue        # expired in queue; try the next one
+                done = self._admit(slot, req)
                 if done is None:
                     break           # slot occupied; next slot
                 outputs.append(done)  # finished at admission; slot still free
         active = sum(s is not None for s in self._slots)
         if active == 0:
             return outputs
+        inj = _faults.active()
+        if inj is not None:
+            inj.fire("serve_decode")
         with self.tracer.span("decode", active=active):
             nxt, keys, self._cache = _decode_program(
                 self.model, self.params, self._cache, self._tokens,
@@ -309,6 +330,8 @@ class ServeEngine:
                 request_id=req.request_id, prompt_len=len(req.prompt),
                 tokens=[], finish_reason="aborted", queue_s=now - t0,
                 ttft_s=None, latency_s=now - t0))
+            if req.on_finish is not None:
+                req.on_finish("aborted")
         for slot, fl in enumerate(self._slots):
             if fl is not None:
                 outs.append(self._finish(slot, "aborted"))
@@ -326,6 +349,25 @@ class ServeEngine:
         return _prefill_program._cache_size()
 
     # ----------------------------------------------------------- internals
+
+    @staticmethod
+    def _expired(req: Request, now: float) -> bool:
+        return (req.deadline_s is not None and req._t_submit is not None
+                and now - req._t_submit > req.deadline_s)
+
+    @staticmethod
+    def _timeout_unadmitted(req: Request) -> RequestOutput:
+        """Terminal output for a request whose deadline expired while it
+        was still queued — no slot, no tokens, no prefill spent on it."""
+        now = time.perf_counter()
+        t0 = req._t_submit if req._t_submit is not None else now
+        out = RequestOutput(
+            request_id=req.request_id, prompt_len=len(req.prompt),
+            tokens=[], finish_reason="timeout", queue_s=now - t0,
+            ttft_s=None, latency_s=now - t0)
+        if req.on_finish is not None:
+            req.on_finish("timeout")
+        return out
 
     def _bucket(self, n: int) -> int:
         b = self.min_bucket
@@ -388,4 +430,6 @@ class ServeEngine:
         self._top_ps[slot] = 1.0
         self.stats.record_completion(latency_s=out.latency_s,
                                      n_tokens=len(out.tokens), reason=reason)
+        if fl.req.on_finish is not None:
+            fl.req.on_finish(reason)
         return out

@@ -57,6 +57,16 @@ class Request:
         default_factory=lambda: f"req-{next(_req_counter)}")
     seed: int = 0
     on_token: Callable[[int], None] | None = None
+    # Wall-clock budget measured from submit: once exceeded, the engine
+    # cancels the request at the next decode boundary (finish_reason
+    # "timeout", slot freed) — a hung/vanished client cannot pin a slot
+    # for the rest of its max_new_tokens. None = no deadline.
+    deadline_s: float | None = None
+    # Terminal notification for streaming callers: called exactly once
+    # with the finish_reason when the request leaves the engine, so a
+    # streaming client learns "timeout"/"aborted" even though on_token
+    # will never fire again.
+    on_finish: Callable[[str], None] | None = None
     # Stamped by ServeEngine.submit (perf_counter clock); queue wait and
     # TTFT are measured from this instant.
     _t_submit: float | None = dataclasses.field(
@@ -69,9 +79,12 @@ class RequestOutput:
 
     ``finish_reason``: "eos" (emitted the EOS token — included in
     ``tokens``, matching ``generate()``), "length" (hit
-    ``max_new_tokens``), or "aborted" (engine shutdown; ``tokens`` holds
-    whatever was emitted, possibly nothing for never-admitted requests).
-    ``ttft_s`` is None for requests aborted before their first token.
+    ``max_new_tokens``), "aborted" (engine shutdown; ``tokens`` holds
+    whatever was emitted, possibly nothing for never-admitted requests),
+    or "timeout" (``Request.deadline_s`` expired — cancelled at a decode
+    boundary with partial ``tokens``, or straight from the queue with
+    none). ``ttft_s`` is None for requests aborted/timed out before
+    their first token.
     """
 
     request_id: str

@@ -29,6 +29,10 @@ EVENTS: dict[str, str] = {
     "span": "a traced span closed: name, dur_ms, depth, parent, rank",
     "heartbeat": "per-rank liveness record (also written as heartbeat files)",
     "stall": "watch flagged a rank with a stale heartbeat",
+    "ckpt_quarantined": "restore found a corrupt/torn checkpoint step and "
+                        "moved it aside; falling back to an older step",
+    "crash_loop": "consecutive restarts died without checkpoint progress; "
+                  "the reconcile loop stopped early (exit codes attached)",
 }
 
 _SNAKE = re.compile(r"^[a-z][a-z0-9_]*$")

@@ -51,12 +51,15 @@ class HeartbeatWriter:
             with open(tmp, "w") as f:
                 json.dump(rec, f)
             os.replace(tmp, path)
-        except OSError as e:
+        except Exception as e:   # noqa: BLE001 — never kill the step
             if not self._warned:
                 self._warned = True
                 import sys
-                print(f"heartbeat write failed (suppressing further "
-                      f"warnings): {e!r}", file=sys.stderr)
+                try:
+                    print(f"heartbeat write failed (suppressing further "
+                          f"warnings): {e!r}", file=sys.stderr)
+                except Exception:
+                    pass
 
 
 @dataclasses.dataclass(frozen=True)

@@ -110,6 +110,7 @@ class Tracer:
         self.min_dur_ms = min_dur_ms
         self.last_span: str | None = None   # most recently COMPLETED span
         self.spans_emitted = 0
+        self._emit_warned = False
         self._local = threading.local()
         self._hist = (registry.histogram(
             "span_duration_ms", "traced span duration in milliseconds",
@@ -136,6 +137,18 @@ class Tracer:
         if self.logger is None or dur_ms < self.min_dur_ms:
             return
         self.spans_emitted += 1
-        self.logger.emit("span", name=span.name, dur_ms=round(dur_ms, 3),
-                         depth=span.depth, parent=span.parent,
-                         rank=self.rank, **span.fields)
+        try:
+            self.logger.emit("span", name=span.name, dur_ms=round(dur_ms, 3),
+                             depth=span.depth, parent=span.parent,
+                             rank=self.rank, **span.fields)
+        except Exception as e:   # noqa: BLE001 — tracing must never kill
+            # the traced work (a full disk under the logger's file is an
+            # observability outage, not a training outage).
+            if not self._emit_warned:
+                self._emit_warned = True
+                import sys
+                try:
+                    print(f"span emit failed (suppressing further "
+                          f"warnings): {e!r}", file=sys.stderr)
+                except Exception:
+                    pass

@@ -16,10 +16,13 @@ manifest can point it at a PVC/GCS mount, and restore-on-start is explicit.
 from __future__ import annotations
 
 import os
+import sys
 from typing import Any
 
 import jax
 import orbax.checkpoint as ocp
+
+from k8s_distributed_deeplearning_tpu.utils import ckpt as ckpt_paths
 
 PyTree = Any
 
@@ -42,7 +45,7 @@ class Checkpointer:
     def __init__(self, directory: str, max_to_keep: int = 3,
                  keep_best_metric: str | None = None,
                  best_mode: str = "max", async_save: bool = False,
-                 portable_transforms=None):
+                 portable_transforms=None, metrics=None):
         """``portable_transforms`` is an optional ``(to_portable,
         from_portable)`` pair canonicalizing the ON-DISK layout: ``save``
         writes ``to_portable(state)`` and the restore paths return
@@ -51,10 +54,20 @@ class Checkpointer:
         ``[V, P, L/PV, ...]`` blocks — ``PipelineTrainer
         .portable_transforms``) pass their reshapes here so checkpoints
         stay interchangeable across schedules and with the non-pipelined
-        trainers (cross-topology restore, the elastic-resize contract)."""
+        trainers (cross-topology restore, the elastic-resize contract).
+
+        *metrics* is an optional :class:`~utils.metrics.MetricsLogger`;
+        integrity failures found by the restore chain emit through it as
+        ``ckpt_quarantined`` events (and always print to stderr — a
+        quarantine must never be silent)."""
         self.directory = os.path.abspath(directory)
         self.keep_best_metric = keep_best_metric
         self.async_save = async_save
+        self.metrics = metrics
+        self.quarantined: list[tuple[int, str]] = []   # (step, reason)
+        # Steps saved but not yet manifested (async saves commit later;
+        # the manifest is written once the step dir exists on disk).
+        self._pending_manifests: set[int] = set()
         self._to_portable, self._from_portable = portable_transforms or (
             None, None)
         if keep_best_metric is not None:
@@ -100,14 +113,29 @@ class Checkpointer:
             state = self._to_portable(state)
         saved = self._mgr.save(step, args=ocp.args.StandardSave(state),
                                force=force, metrics=metrics)
+        if saved:
+            self._pending_manifests.add(step)
         if not self.async_save:
             self._mgr.wait_until_finished()
+        self._flush_manifests()
         return saved
 
     def wait(self) -> None:
         """Block until outstanding async saves are durable (no-op when
         synchronous)."""
         self._mgr.wait_until_finished()
+        self._flush_manifests()
+
+    def _flush_manifests(self) -> None:
+        """Write integrity manifests for every save whose step dir has
+        committed (sync saves: immediately; async saves: whenever the
+        background write finishes — next save()/wait()/close() picks them
+        up), then GC manifests orphaned by Orbax retention."""
+        for step in sorted(self._pending_manifests):
+            if os.path.isdir(os.path.join(self.directory, str(step))):
+                ckpt_paths.write_manifest(self.directory, step)
+                self._pending_manifests.discard(step)
+        ckpt_paths.gc_manifests(self.directory)
 
     def best_step(self) -> int | None:
         """Step of the best checkpoint by the tracked metric (None when not
@@ -120,13 +148,47 @@ class Checkpointer:
         return self._mgr.latest_step()
 
     def restore_latest(self, abstract_state: PyTree) -> tuple[PyTree, int] | None:
-        """Restore the newest checkpoint, or None if the directory is empty —
-        the restore-on-start path (``tensorflow_mnist.py:162-167``).
+        """Restore the newest GOOD checkpoint, or None if none loads —
+        the restore-on-start path (``tensorflow_mnist.py:162-167``),
+        hardened into a fallback chain: each candidate step is verified
+        against its integrity manifest first (size + checksum of every
+        file), and a step that fails verification — or whose restore
+        raises — is quarantined (renamed to ``quarantined-<step>-<k>``,
+        ``ckpt_quarantined`` emitted) and the chain falls back to the next
+        older step. A pod killed mid-write or a bit-flipped file can
+        therefore never brick the job; it costs exactly the steps since
+        the previous good save.
 
         ``abstract_state`` is a matching pytree (concrete arrays or
         ShapeDtypeStructs) used to restore with correct shardings.
         """
-        return self._restore_step(self._mgr.latest_step(), abstract_state)
+        while True:
+            steps = ckpt_paths.steps_on_disk(self.directory)
+            if not steps:
+                return None
+            step = steps[-1]
+            problem = ckpt_paths.verify_manifest(self.directory, step)
+            if problem is None:
+                try:
+                    return self._restore_step(step, abstract_state)
+                except Exception as e:   # noqa: BLE001 — any torn read
+                    problem = f"restore raised {type(e).__name__}: {e}"
+            self._quarantine(step, problem)
+
+    def _quarantine(self, step: int, reason: str) -> None:
+        dst = ckpt_paths.quarantine_step(self.directory, step, reason)
+        self.quarantined.append((step, reason))
+        print(f"checkpoint step {step} quarantined -> {dst}: {reason}",
+              file=sys.stderr, flush=True)
+        if self.metrics is not None:
+            self.metrics.emit("ckpt_quarantined", step=step, reason=reason,
+                              moved_to=dst)
+        # The manager caches its step list; after the rename it must
+        # re-scan or later restores/saves reference a vanished dir.
+        try:
+            self._mgr.reload()
+        except Exception:   # older orbax: recreate instead of reload
+            pass
 
     def restore_best(self, abstract_state: PyTree) -> tuple[PyTree, int] | None:
         """Restore the best checkpoint by the tracked metric (best-model
@@ -223,4 +285,5 @@ class Checkpointer:
 
     def close(self) -> None:
         self._mgr.wait_until_finished()   # drain async saves before closing
+        self._flush_manifests()
         self._mgr.close()

@@ -16,12 +16,17 @@ import gzip
 import hashlib
 import os
 import struct
+import sys
 import tempfile
+import time
 import urllib.error
 import urllib.request
-from typing import Iterator, Mapping
+from typing import Callable, Iterator, Mapping
 
 import numpy as np
+
+from k8s_distributed_deeplearning_tpu import faults as _faults
+from k8s_distributed_deeplearning_tpu.utils.retry import retry_transient
 
 PyTree = dict
 
@@ -565,7 +570,9 @@ class TokenShardBatcher(_EpochShardedBatcher):
     def __init__(self, data_dir: str, batch_size: int, seq_len: int,
                  seed: int = 0, process_index: int = 0,
                  num_processes: int = 1, hold_out_tail: int = 0,
-                 vocab_size: int | None = None):
+                 vocab_size: int | None = None, io_retries: int = 2,
+                 io_backoff_s: float = 0.05,
+                 sleep: Callable[[float], None] = time.sleep):
         """*hold_out_tail* excludes the last N tokens of the final shard
         from the training window space (the held-out eval slice — read it
         via :meth:`tail_tokens`; without the exclusion, eval tokens would
@@ -573,7 +580,13 @@ class TokenShardBatcher(_EpochShardedBatcher):
         checks the FIRST and LAST shard's token ids — cheap relative to a
         full-corpus scan, and catches the common corruptions (wrong
         tokenizer, wrong dtype decode, truncation garbage) at both ends
-        instead of letting the embedding gather clamp them silently."""
+        instead of letting the embedding gather clamp them silently.
+
+        *io_retries*/*io_backoff_s*: a batch read that raises ``OSError``
+        (network-filesystem blip on a mmap page fault, or the injected
+        ``shard_read`` fault) is retried with bounded exponential backoff
+        before the error surfaces — transient IO must cost latency, not
+        the job."""
         if seq_len <= 0:
             raise ValueError("seq_len must be positive")
         names = sorted(n for n in os.listdir(data_dir)
@@ -625,6 +638,9 @@ class TokenShardBatcher(_EpochShardedBatcher):
         if total < 1:
             raise ValueError(
                 f"shards in {data_dir!r} too small for seq_len={seq_len}")
+        self._io_retries = io_retries
+        self._io_backoff_s = io_backoff_s
+        self._io_sleep = sleep
         super().__init__(total, batch_size, seed, process_index,
                          num_processes, what="windows")
 
@@ -645,12 +661,24 @@ class TokenShardBatcher(_EpochShardedBatcher):
         return np.asarray(self._shards[-1][-self.hold_out_tail:], np.int32)
 
     def _make_batch(self, sel: np.ndarray) -> PyTree:
-        out = np.empty((len(sel), self.seq_len + 1), np.int32)
-        shard_of = np.searchsorted(self._cum, sel, side="right") - 1
-        for i, (w, s) in enumerate(zip(sel, shard_of)):
-            off = (int(w) - int(self._cum[s])) * self.seq_len
-            out[i] = self._shards[s][off:off + self.seq_len + 1]
-        return {"tokens": out}
+        def read() -> PyTree:
+            inj = _faults.active()
+            if inj is not None:
+                inj.fire("shard_read")
+            out = np.empty((len(sel), self.seq_len + 1), np.int32)
+            shard_of = np.searchsorted(self._cum, sel, side="right") - 1
+            for i, (w, s) in enumerate(zip(sel, shard_of)):
+                off = (int(w) - int(self._cum[s])) * self.seq_len
+                out[i] = self._shards[s][off:off + self.seq_len + 1]
+            return {"tokens": out}
+
+        def warn(attempt: int, exc: BaseException, delay: float) -> None:
+            print(f"shard read failed (attempt {attempt}): {exc}; "
+                  f"retrying in {delay:.2f}s", file=sys.stderr, flush=True)
+
+        return retry_transient(
+            read, retries=self._io_retries, backoff_s=self._io_backoff_s,
+            sleep=self._io_sleep, on_retry=warn)
 
 
 class ShardedBatcher(_EpochShardedBatcher):

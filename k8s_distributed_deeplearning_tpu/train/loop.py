@@ -17,6 +17,7 @@ from typing import Any, Callable, Iterator
 
 import jax
 
+from k8s_distributed_deeplearning_tpu import faults as _faults
 from k8s_distributed_deeplearning_tpu.parallel import distributed
 from k8s_distributed_deeplearning_tpu.telemetry.heartbeat import (
     HeartbeatWriter)
@@ -91,6 +92,7 @@ def fit(
     *telemetry*: a :class:`telemetry.bridge.TrainTelemetry` whose gauges
     update at the ``log_every`` cadence for the ``/metrics`` scrape.
     """
+    inj = _faults.active()
     start_step = 0
     if checkpointer is not None:
         restored = checkpointer.restore_latest(state)
@@ -106,14 +108,19 @@ def fit(
     step_last = start_step  # steps actually in the current timing window
     step = start_step
     for step in range(start_step, num_steps):
+        if inj is not None:
+            inj.fire("step", step=step)
         if profiler is not None:
             profiler.step_hook(step)
         with tr.span("data_wait"):
+            if inj is not None:
+                inj.fire("data_wait", step=step)
             batch = next(batch_iter)
         step_rng = jax.random.fold_in(rng, step)
         with tr.span("step"):
             state, loss, aux = step_fn(state, batch, step_rng)
-        if heartbeat is not None:
+        if heartbeat is not None and (
+                inj is None or not inj.suppressed("heartbeat", step=step + 1)):
             heartbeat.beat(step + 1, last_span=tr.last_span)
 
         if preemption is not None:
@@ -179,6 +186,10 @@ def fit(
                 metrics.emit("checkpoint", step=step + 1)
             if telemetry is not None:
                 telemetry.on_checkpoint()
+            if inj is not None:
+                checkpointer.wait()
+                inj.fire("checkpoint_saved", step=step + 1,
+                         path=checkpointer.directory)
 
     if profiler is not None:
         profiler.stop()
@@ -190,6 +201,10 @@ def fit(
             metrics.emit("checkpoint", step=num_steps, final=True)
         if telemetry is not None:
             telemetry.on_checkpoint()
+        if inj is not None:
+            checkpointer.wait()
+            inj.fire("checkpoint_saved", step=num_steps,
+                     path=checkpointer.directory)
     return state
 
 

@@ -34,6 +34,7 @@ class MetricsLogger:
         self.job = job
         self._file = open(path, "a") if (path and enabled) else None
         self._t0 = time.monotonic()
+        self._emit_warned = False
 
     def emit(self, event: str, **fields: Any) -> None:
         if not self.enabled:
@@ -55,10 +56,21 @@ class MetricsLogger:
         # default=repr: non-JSON-serializable values degrade to their repr
         # string instead of raising — the event still lands in Loki.
         line = json.dumps(rec, default=repr)
-        print(line, file=self.stream, flush=True)
-        if self._file:
-            self._file.write(line + "\n")
-            self._file.flush()
+        try:
+            print(line, file=self.stream, flush=True)
+            if self._file:
+                self._file.write(line + "\n")
+                self._file.flush()
+        except Exception as e:   # noqa: BLE001 — a broken pipe or full
+            # disk under the metrics sink must degrade observability, not
+            # the training step that emitted the event.
+            if not self._emit_warned:
+                self._emit_warned = True
+                try:
+                    print(f"metrics emit failed (suppressing further "
+                          f"warnings): {e!r}", file=sys.stderr)
+                except Exception:
+                    pass
 
     def train_step(self, step: int, loss: float, step_time_ms: float,
                    examples_per_sec: float, per_chip: float,

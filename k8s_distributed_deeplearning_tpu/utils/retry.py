@@ -1,0 +1,51 @@
+"""Bounded exponential-backoff retry for transient failures.
+
+One policy, two consumers: ``launch/watch.py``'s kubectl client (apiserver
+blips over an hours-long reconcile) and ``train/data.py``'s shard reads
+(NFS/GCS-fuse hiccups mid-epoch). The shape is deliberately strict:
+
+- bounded — ``retries`` extra attempts, never a forever-loop against a
+  genuinely broken target;
+- selective — ``is_transient`` decides per exception; permanent errors
+  (NotFound, bad config, corrupt file) surface on the FIRST attempt, since
+  retrying them only delays the diagnosis;
+- exponential — waits start at ``backoff_s`` and double, so a flapping
+  dependency isn't hammered at a fixed period.
+
+jax-free by design (imported from control-plane code).
+"""
+from __future__ import annotations
+
+import time
+from typing import Callable, TypeVar
+
+T = TypeVar("T")
+
+
+def retry_transient(fn: Callable[[], T], *, retries: int = 2,
+                    backoff_s: float = 1.0,
+                    sleep: Callable[[float], None] = time.sleep,
+                    is_transient: Callable[[BaseException], bool]
+                    = lambda e: isinstance(e, OSError),
+                    on_retry: Callable[[int, BaseException, float], None]
+                    | None = None) -> T:
+    """Call ``fn()`` with up to *retries* retried attempts.
+
+    An exception for which ``is_transient`` is False — or one raised on the
+    final attempt — propagates. ``on_retry(attempt_number, exc, delay)``
+    observes each retry before its backoff sleep (loggers, test probes).
+    *sleep* is injectable so tests assert the exact backoff schedule
+    without waiting it out.
+    """
+    delay = backoff_s
+    for attempt in range(retries + 1):
+        try:
+            return fn()
+        except Exception as e:
+            if attempt == retries or not is_transient(e):
+                raise
+            if on_retry is not None:
+                on_retry(attempt + 1, e, delay)
+        sleep(delay)
+        delay *= 2
+    raise AssertionError("unreachable")

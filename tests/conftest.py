@@ -42,6 +42,10 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO_ROOT not in sys.path:
     sys.path.insert(0, REPO_ROOT)
 
+# Tests call jax.shard_map directly; importing the package installs the
+# jax<0.5 experimental alias (see k8s_distributed_deeplearning_tpu/__init__).
+import k8s_distributed_deeplearning_tpu  # noqa: E402,F401
+
 import pytest  # noqa: E402
 
 

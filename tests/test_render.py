@@ -110,3 +110,46 @@ def test_deploy_assets_are_valid():
     assert {"TPUJOB_COORDINATOR_ADDRESS", "TPUJOB_NUM_PROCESSES",
             "TPUJOB_PROCESS_ID"} <= env
     json.load(open(os.path.join(root, "grafana-dashboard.json")))
+
+
+def test_fault_plan_renders_env_and_validates():
+    """JobConfig.fault_plan rides into the manifest as TPUJOB_FAULT_PLAN
+    (the chaos experiment is fully described by the rendered object) and a
+    well-formed plan passes offline validation."""
+    import json
+
+    from k8s_distributed_deeplearning_tpu.launch import validate
+
+    plan = json.dumps({"faults": [{"site": "step", "action": "exit",
+                                   "rank": 0, "step": 100}]})
+    cfg = JobConfig(num_workers=2, fault_plan=plan)
+    docs = render.render_all(cfg)
+    env = {e["name"]: e for e in
+           docs[2]["spec"]["template"]["spec"]["containers"][0]["env"]}
+    assert env["TPUJOB_FAULT_PLAN"]["value"] == plan
+    assert validate.validate(docs) == []
+    # no plan configured -> the env var is absent entirely (zero-cost path)
+    docs = render.render_all(JobConfig(num_workers=2))
+    names = {e["name"] for e in
+             docs[2]["spec"]["template"]["spec"]["containers"][0]["env"]}
+    assert "TPUJOB_FAULT_PLAN" not in names
+    # "@/path" plans are structural (file lives in the container): accepted
+    docs = render.render_all(JobConfig(num_workers=2,
+                                       fault_plan="@/mnt/plan.json"))
+    assert validate.validate(docs) == []
+
+
+def test_invalid_fault_plan_fails_validation():
+    """A plan that is bad JSON or names a nonsensical site/action pair is a
+    render-time error, not a chaos run that silently injects nothing."""
+    import json
+
+    from k8s_distributed_deeplearning_tpu.launch import validate
+
+    errs = validate.validate(render.render_all(
+        JobConfig(num_workers=2, fault_plan="{not json")))
+    assert any("TPUJOB_FAULT_PLAN" in e for e in errs)
+    bad = json.dumps({"faults": [{"site": "heartbeat", "action": "exit"}]})
+    errs = validate.validate(render.render_all(
+        JobConfig(num_workers=2, fault_plan=bad)))
+    assert any("TPUJOB_FAULT_PLAN" in e and "not valid" in e for e in errs)

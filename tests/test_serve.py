@@ -257,3 +257,57 @@ def test_serving_stats_accounting(tiny):
     assert s["finish_reasons"] == {"length": 6}
     assert 0.0 < s["mean_slot_occupancy"] <= 1.0
     assert s["ttft_p50_ms"] is not None and s["latency_p95_ms"] is not None
+
+
+def test_deadline_expired_mid_flight_cancels_at_decode_boundary(tiny):
+    """A request whose deadline passes mid-decode is cancelled at the next
+    step() boundary: finish_reason "timeout", partial tokens delivered, the
+    on_finish callback told, and the freed slot immediately reusable."""
+    import time
+
+    model, params, cfg = tiny
+    prompts, _ = _workload(cfg, 3, seed=11)
+    eng = ServeEngine(model, params, num_slots=2, eos_id=None)
+    eng.run([Request(prompt=prompts[0], max_new_tokens=2)])   # warm compile
+
+    reasons = []
+    victim = Request(prompt=prompts[1], max_new_tokens=40, deadline_s=0.2,
+                     on_finish=reasons.append)
+    eng.submit(victim)
+    outs = eng.step()                      # admission + first decode
+    assert outs == []
+    time.sleep(0.25)                       # let the deadline lapse
+    outs = eng.step()
+    timed = next(o for o in outs if o.request_id == victim.request_id)
+    assert timed.finish_reason == "timeout"
+    assert 1 <= len(timed.tokens) < 40     # partial stream, not a full run
+    assert reasons == ["timeout"]
+    assert eng.stats.summary()["finish_reasons"]["timeout"] == 1
+    # the slot is clean: the next request through it has exact parity
+    after = Request(prompt=prompts[2], max_new_tokens=6)
+    outs = {o.request_id: o for o in eng.run([after])}
+    np.testing.assert_array_equal(
+        np.asarray(outs[after.request_id].tokens),
+        _ref_greedy(model, params, prompts[2], 6))
+
+
+def test_deadline_expired_in_queue_never_prefills(tiny):
+    """A request already past its deadline when popped completes as
+    "timeout" with zero tokens and no ttft — no prefill is spent on it —
+    and requests behind it in the queue are unaffected."""
+    model, params, cfg = tiny
+    prompts, _ = _workload(cfg, 2, seed=12)
+    eng = ServeEngine(model, params, num_slots=2, eos_id=None)
+    reasons = []
+    hung = Request(prompt=prompts[0], max_new_tokens=30, deadline_s=1e-9,
+                   on_finish=reasons.append)
+    live = Request(prompt=prompts[1], max_new_tokens=5)
+    outs = {o.request_id: o for o in eng.run([hung, live])}
+    timed = outs[hung.request_id]
+    assert timed.finish_reason == "timeout"
+    assert timed.tokens == [] and timed.ttft_s is None
+    assert reasons == ["timeout"]
+    # the hung client never stalled the other slot
+    np.testing.assert_array_equal(
+        np.asarray(outs[live.request_id].tokens),
+        _ref_greedy(model, params, prompts[1], 5))

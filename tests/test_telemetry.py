@@ -231,3 +231,59 @@ def test_tracing_overhead_under_two_percent():
     assert out["step_ms_plain"] > 0 and out["step_ms_traced"] > 0
     assert out["spans_emitted_last_window"] == 2 * 12   # data_wait + step
     assert out["telemetry_overhead_pct"] < 2.0, out
+
+
+def test_heartbeat_beat_never_raises_on_broken_target(tmp_path, capsys):
+    """Liveness reporting must never kill the step it reports on: a writer
+    whose target directory turns unwritable (volume yanked mid-run)
+    swallows every failure after one warning."""
+    marker = tmp_path / "regular-file"
+    marker.write_text("not a directory")
+    writer = HeartbeatWriter(str(tmp_path / "hb"), rank=0)
+    # Break the target AFTER construction: the open() inside beat() now
+    # raises NotADirectoryError (chmod tricks don't apply — tests run as
+    # root, for whom mode bits are advisory).
+    writer.directory = str(marker / "sub")
+    for step in range(3):
+        writer.beat(step)            # must not raise
+    err = capsys.readouterr().err
+    assert err.count("heartbeat write failed") == 1
+    # a healthy writer alongside is unaffected
+    ok = HeartbeatWriter(str(tmp_path / "hb2"), rank=1)
+    ok.beat(7)
+    from k8s_distributed_deeplearning_tpu.telemetry.heartbeat import (
+        read_heartbeats)
+    assert read_heartbeats(str(tmp_path / "hb2"))[0]["step"] == 7
+
+
+def test_tracer_emit_failure_never_raises(capsys):
+    """A tracer whose logger dies (full disk, closed stream) times spans,
+    warns once, and never propagates into the traced work."""
+    class _DeadLogger:
+        def emit(self, *a, **kw):
+            raise OSError("disk full")
+
+    tr = Tracer(logger=_DeadLogger(), rank=0)
+    for i in range(3):
+        with tr.span("step", step=i):
+            pass
+    assert tr.last_span == "step"    # spans still recorded
+    err = capsys.readouterr().err
+    assert err.count("span emit failed") == 1
+
+
+def test_metrics_logger_emit_failure_never_raises(capsys):
+    """MetricsLogger.emit with a dead stream warns once and drops the
+    event instead of killing the caller."""
+    class _DeadStream:
+        def write(self, *_a):
+            raise OSError("broken pipe")
+
+        def flush(self):
+            raise OSError("broken pipe")
+
+    log = MetricsLogger(stream=_DeadStream(), job="t")
+    for i in range(3):
+        log.emit("checkpoint", step=i)   # must not raise
+    err = capsys.readouterr().err
+    assert err.count("metrics emit failed") == 1
