@@ -601,6 +601,210 @@ def measure_serve(n_requests: int = 64, num_slots: int = 8,
     }
 
 
+def _serve_cpu_model(max_seq: int):
+    """The serve-suite bench model: llama-small 124M on accelerators, a
+    narrower f32 config on CPU CI hosts (same workload shape — the claims
+    are about scheduling/caching, not the chip)."""
+    import jax
+    import jax.numpy as jnp
+
+    from k8s_distributed_deeplearning_tpu.models import llama
+
+    on_cpu = jax.devices()[0].platform == "cpu"
+    if on_cpu:
+        # Same narrow trunk as measure_serve's CPU config but with the
+        # small preset's REAL 32k vocab: the lm_head is a first-order term
+        # of the decode/prefill cost balance these suites measure (it runs
+        # in the decode and final-chunk programs but is dead-code-
+        # eliminated from intermediate chunks), and a toy vocab would
+        # understate the decode step a chunk must interleave with.
+        cfg = llama.config_tiny(
+            vocab_size=32000, dim=256, n_layers=4, n_heads=8, n_kv_heads=4,
+            mlp_dim=1024, max_seq_len=max_seq, dtype=jnp.float32,
+            scan_layers=False)
+    else:
+        cfg = _llama_small_cfg(max_seq, remat=False)
+    model = llama.LlamaLM(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    return model, params, cfg, on_cpu
+
+
+def measure_serve_prefix(n_requests: int = 12, num_slots: int = 4,
+                         prefix_len: int = 512, unique_len: int = 16,
+                         out_len: int = 8, cache_mb: float = 64.0,
+                         seed: int = 0) -> dict:
+    """Shared-prefix workload (the prefix cache's target): *n_requests*
+    prompts sharing a *prefix_len*-token system prompt, each with a short
+    unique tail and a short decode — TTFT-dominated, so the win IS the
+    skipped prefill. Cache off: every admission prefills prefix+tail.
+    Cache on: request 1 populates the trie, the rest paste the prefix and
+    prefill only their tail. One full warmup replay per mode covers every
+    compile (decode/prefill/paste/copy-out programs); the timed replay
+    uses fresh engines (cold trie — population cost honestly included)."""
+    import numpy as np
+
+    from k8s_distributed_deeplearning_tpu.serve import Request, ServeEngine
+
+    max_seq = prefix_len + unique_len + out_len + 32
+    model, params, cfg, on_cpu = _serve_cpu_model(max_seq)
+    rng = np.random.default_rng(seed)
+    shared = rng.integers(0, cfg.vocab_size, size=prefix_len)
+    prompts = [np.concatenate([
+        shared, rng.integers(0, cfg.vocab_size, size=unique_len)
+    ]).astype(np.int32) for _ in range(n_requests)]
+
+    def run(mb: float):
+        eng = ServeEngine(model, params, num_slots=num_slots,
+                          max_queue=n_requests,
+                          prefix_cache_mb=(mb or None))
+        eng.run([Request(prompt=p, max_new_tokens=out_len)
+                 for p in prompts])
+        return eng.stats.summary()
+
+    run(0.0)                                   # warmup replays (compiles)
+    run(cache_mb)
+    t0 = time.perf_counter()
+    off = run(0.0)
+    off_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    on = run(cache_mb)
+    on_s = time.perf_counter() - t0
+
+    total = n_requests * out_len
+    return {
+        "serve_prefix_ttft_p50_ms_off": off["ttft_p50_ms"],
+        "serve_prefix_ttft_p50_ms_on": on["ttft_p50_ms"],
+        "serve_prefix_ttft_speedup": round(
+            off["ttft_p50_ms"] / on["ttft_p50_ms"], 2),
+        "serve_prefix_tokens_per_sec_off": round(total / off_s, 1),
+        "serve_prefix_tokens_per_sec_on": round(total / on_s, 1),
+        "serve_prefix_hit_rate": on["prefix_hit_rate"],
+        "serve_prefix_config": {
+            "requests": n_requests, "slots": num_slots,
+            "prefix_len": prefix_len, "unique_len": unique_len,
+            "out_len": out_len, "cache_mb": cache_mb,
+            "model": ("cpu-serve (dim 256, 4L, 32k vocab, f32)" if on_cpu
+                      else "llama-small 124M bf16"),
+        },
+    }
+
+
+def measure_serve_chunked(long_prompt: int = 1024, chunk: int = 32,
+                          victim_out: int = 96, inject_after: int = 8,
+                          seed: int = 0) -> dict:
+    """Mixed long-prompt/short-decode workload: a short-prompt VICTIM
+    streams tokens while a *long_prompt*-token request lands mid-decode.
+    Unchunked, the monolithic prefill freezes the victim for its full
+    duration (one huge inter-token gap); chunked, each iteration runs at
+    most *chunk* real prefill tokens between the victim's tokens. Reports
+    the victim's steady-state median inter-token gap, its p95 and max gap
+    across the admission, and the max/steady ratio per mode (the ISSUE's
+    "within 2x steady-state" bound is on the chunked mode)."""
+    import numpy as np
+
+    from k8s_distributed_deeplearning_tpu.serve import Request, ServeEngine
+
+    max_seq = long_prompt + 64
+    model, params, cfg, on_cpu = _serve_cpu_model(max_seq)
+    rng = np.random.default_rng(seed)
+    victim_prompt = rng.integers(0, cfg.vocab_size, size=32).astype(np.int32)
+    big_prompt = rng.integers(0, cfg.vocab_size,
+                              size=long_prompt).astype(np.int32)
+
+    def run(chunk_tokens: int | None):
+        eng = ServeEngine(model, params, num_slots=2,
+                          prefill_chunk_tokens=chunk_tokens)
+        stamps: list[float] = []
+        eng.submit(Request(prompt=victim_prompt, max_new_tokens=victim_out,
+                           on_token=lambda _t: stamps.append(
+                               time.perf_counter())))
+        injected = False
+        while eng.busy():
+            eng.step()
+            if not injected and len(stamps) >= inject_after:
+                eng.submit(Request(prompt=big_prompt, max_new_tokens=8))
+                injected = True
+        gaps = np.diff(np.asarray(stamps))
+        # Steady state = gaps before the injection; the admission window
+        # (prefill interleaved or monolithic) lives in the tail gaps.
+        steady = float(np.median(gaps[:max(inject_after - 2, 1)]))
+        return {"steady_ms": steady * 1e3,
+                "p95_ms": float(np.percentile(gaps, 95)) * 1e3,
+                "max_ms": float(gaps.max()) * 1e3,
+                "max_over_steady": float(gaps.max() / steady)}
+
+    run(None)                                  # warmup replays (compiles)
+    run(chunk)
+    off = run(None)
+    on = run(chunk)
+    return {
+        "serve_chunked_victim_gap_p95_ms_off": round(off["p95_ms"], 3),
+        "serve_chunked_victim_gap_p95_ms_on": round(on["p95_ms"], 3),
+        "serve_chunked_victim_max_gap_ms_off": round(off["max_ms"], 3),
+        "serve_chunked_victim_max_gap_ms_on": round(on["max_ms"], 3),
+        "serve_chunked_max_over_steady_off": round(off["max_over_steady"], 2),
+        "serve_chunked_max_over_steady_on": round(on["max_over_steady"], 2),
+        "serve_chunked_config": {
+            "long_prompt": long_prompt, "chunk": chunk,
+            "victim_out": victim_out, "inject_after": inject_after,
+            "model": ("cpu-serve (dim 256, 4L, 32k vocab, f32)" if on_cpu
+                      else "llama-small 124M bf16"),
+        },
+    }
+
+
+def measure_serve_overhead(n_requests: int = 8, num_slots: int = 4,
+                           out_len: int = 48, repeats: int = 3,
+                           seed: int = 0) -> dict:
+    """Prefix-cache bookkeeping overhead with the cache ENABLED BUT EMPTY:
+    the budget is set below one block's bytes, so every lookup walks the
+    (empty) trie and every insert is rejected by the size check BEFORE any
+    device copy — the measured delta is pure host bookkeeping on the
+    admission path. Same interleaved min-of-repeats discipline as
+    measure_telemetry_overhead; the serve-suite gate asserts < 2%."""
+    import numpy as np
+
+    from k8s_distributed_deeplearning_tpu.serve import Request, ServeEngine
+
+    max_seq = 256
+    model, params, cfg, _ = _serve_cpu_model(max_seq)
+    rng = np.random.default_rng(seed)
+    prompts = [rng.integers(0, cfg.vocab_size, size=int(
+        rng.integers(32, 128))).astype(np.int32) for _ in range(n_requests)]
+    # Below one block: engine._block_nbytes(32) for every bench config is
+    # far above 1 KiB, so inserts skip pre-copy and the trie stays empty.
+    tiny_mb = 1 / 1024
+
+    def run(mb: float | None) -> float:
+        eng = ServeEngine(model, params, num_slots=num_slots,
+                          max_queue=n_requests, prefix_cache_mb=mb)
+        reqs = [Request(prompt=p, max_new_tokens=out_len) for p in prompts]
+        t0 = time.perf_counter()
+        eng.run(reqs)
+        steps = max(eng.stats.steps, 1)
+        if mb:
+            assert eng.prefix_cache is not None
+            assert len(eng.prefix_cache) == 0, "trie must stay empty"
+        return (time.perf_counter() - t0) / steps
+
+    run(None)                                  # warmup replays (compiles)
+    run(tiny_mb)
+    times = {"off": float("inf"), "on": float("inf")}
+    for _ in range(repeats):
+        times["off"] = min(times["off"], run(None))
+        times["on"] = min(times["on"], run(tiny_mb))
+    pct = (times["on"] - times["off"]) / times["off"] * 100.0
+    return {
+        "serve_prefix_empty_overhead_pct": round(pct, 3),
+        "serve_step_ms_cache_off": round(times["off"] * 1e3, 4),
+        "serve_step_ms_cache_empty": round(times["on"] * 1e3, 4),
+        "serve_overhead_config": {"requests": n_requests,
+                                  "slots": num_slots, "out_len": out_len,
+                                  "repeats": repeats},
+    }
+
+
 def measure_telemetry_overhead(steps: int = 30, warmup: int = 5,
                                batch_size: int = 512,
                                repeats: int = 3) -> dict:
@@ -973,6 +1177,9 @@ def main() -> None:
         return
     if args.suite == "serve":
         extra = measure_serve()
+        extra.update(measure_serve_prefix())
+        extra.update(measure_serve_chunked())
+        extra.update(measure_serve_overhead())
         emit({
             "metric": "serve_tokens_per_sec",
             "value": extra["serve_tokens_per_sec"],

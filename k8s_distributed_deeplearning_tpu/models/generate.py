@@ -52,6 +52,43 @@ def prefill(model, params: PyTree, prompt: jax.Array, *,
     return logits, vars_["cache"]
 
 
+def prefill_chunk(model, params: PyTree, cache: PyTree, chunk: jax.Array, *,
+                  start: jax.Array | int | None = None,
+                  positions: jax.Array | None = None,
+                  segment_ids: jax.Array | None = None
+                  ) -> tuple[jax.Array, PyTree]:
+    """Resume prefill on an EXISTING cache: run ``chunk`` ([B, C] int32)
+    through the shared-cursor decode path starting at cache position
+    ``start`` (default: wherever the cache's cursor already is). Returns
+    ``(logits [B, C, V], cache)`` with the cursor advanced by C.
+
+    This is what makes chunked prefill possible without touching the model:
+    the shared-cursor decode branch (models/transformer.py) already appends
+    a [B, C] window at the scalar cursor with causal masking against the
+    full written prefix, so feeding a prompt in C-token slices produces the
+    same KV (and the same logits per position) as one monolithic prefill —
+    KV projections are per-token and the attended region per position is
+    identical. ``start`` rewrites the cache's ``cache_index`` leaves before
+    the step, which lets the serving engine (a) resume after splicing a
+    cached prefix whose cursor is mid-prompt and (b) re-run an overlapping
+    final chunk idempotently (rewinding rewrites identical KV in place).
+    """
+    if start is not None:
+        def set_cursor(path, x):
+            if getattr(path[-1], "key", None) == "cache_index":
+                return jnp.full(x.shape, start, x.dtype)
+            return x
+        cache = jax.tree_util.tree_map_with_path(set_cursor, cache)
+    kw: dict = {}
+    if positions is not None:
+        kw["positions"] = positions
+    if segment_ids is not None:
+        kw["segment_ids"] = segment_ids
+    logits, vars_ = model.apply({"params": params, "cache": cache}, chunk,
+                                decode=True, mutable=["cache"], **kw)
+    return logits, vars_["cache"]
+
+
 def decode_step(model, params: PyTree, cache: PyTree, token: jax.Array, *,
                 positions: jax.Array | None = None,
                 segment_ids: jax.Array | None = None

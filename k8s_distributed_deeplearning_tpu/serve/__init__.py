@@ -7,9 +7,10 @@ freed slots re-admitted in flight (Orca-style iteration scheduling + vLLM-style
 slot reuse). See :mod:`serve.engine` for the design contract.
 """
 from k8s_distributed_deeplearning_tpu.serve.engine import ServeEngine
+from k8s_distributed_deeplearning_tpu.serve.prefix_cache import PrefixCache
 from k8s_distributed_deeplearning_tpu.serve.request import (
     QueueFull, Request, RequestOutput, SamplingParams)
 from k8s_distributed_deeplearning_tpu.serve.scheduler import RequestQueue
 
 __all__ = ["ServeEngine", "Request", "RequestOutput", "SamplingParams",
-           "RequestQueue", "QueueFull"]
+           "RequestQueue", "QueueFull", "PrefixCache"]

@@ -37,6 +37,18 @@ def main(argv: list[str] | None = None) -> int:
                     metavar=("LO", "HI"))
     ap.add_argument("--out-len", type=int, nargs=2, default=(16, 64),
                     metavar=("LO", "HI"))
+    ap.add_argument("--shared-prefix-len", type=int, default=0,
+                    help="prepend this many shared tokens to every prompt "
+                         "(models fleet traffic with a common system "
+                         "prompt — the prefix cache's target workload)")
+    ap.add_argument("--prefix-cache-mb", type=float, default=0.0,
+                    help="prefix-reuse KV cache budget in MiB (0 = off): "
+                         "prompts sharing a prefix splice its cached KV "
+                         "instead of recomputing it")
+    ap.add_argument("--prefill-chunk-tokens", type=int, default=0,
+                    help="bound each iteration's prefill work to this many "
+                         "prompt tokens (0 = off); must be a multiple of "
+                         "the 32-token prefill bucket granularity")
     ap.add_argument("--temperature", type=float, default=0.0)
     ap.add_argument("--top-k", type=int, default=0)
     ap.add_argument("--top-p", type=float, default=1.0)
@@ -51,6 +63,23 @@ def main(argv: list[str] | None = None) -> int:
                     help="emit span events (prefill/decode/admission) "
                          "through the JSONL stream")
     args = ap.parse_args(argv)
+
+    # Flag validation BEFORE the heavy imports/model build: a bad flag
+    # dies with usage text instead of a traceback from ServeEngine (the
+    # engine re-checks the same invariants for library callers).
+    min_bucket = 32
+    if args.prefill_chunk_tokens and (
+            args.prefill_chunk_tokens < min_bucket
+            or args.prefill_chunk_tokens % min_bucket):
+        ap.error(f"--prefill-chunk-tokens ({args.prefill_chunk_tokens}) "
+                 f"must be a multiple of the prefill bucket granularity "
+                 f"({min_bucket})")
+    if args.prefix_cache_mb < 0:
+        ap.error(f"--prefix-cache-mb must be >= 0, got "
+                 f"{args.prefix_cache_mb}")
+    if args.shared_prefix_len < 0:
+        ap.error(f"--shared-prefix-len must be >= 0, got "
+                 f"{args.shared_prefix_len}")
 
     import jax
     import jax.numpy as jnp
@@ -76,8 +105,9 @@ def main(argv: list[str] | None = None) -> int:
 
     p_lo, p_hi = args.prompt_len
     o_lo, o_hi = args.out_len
-    if p_hi + o_hi > cfg.max_seq_len:
-        ap.error(f"prompt-len hi ({p_hi}) + out-len hi ({o_hi}) exceeds "
+    if args.shared_prefix_len + p_hi + o_hi > cfg.max_seq_len:
+        ap.error(f"shared-prefix-len ({args.shared_prefix_len}) + "
+                 f"prompt-len hi ({p_hi}) + out-len hi ({o_hi}) exceeds "
                  f"--max-seq-len ({cfg.max_seq_len})")
     rng = np.random.default_rng(args.seed)
     sampling = SamplingParams(temperature=args.temperature,
@@ -87,9 +117,12 @@ def main(argv: list[str] | None = None) -> int:
     if args.trace:
         from k8s_distributed_deeplearning_tpu.telemetry.trace import Tracer
         tracer = Tracer(logger)
-    engine = ServeEngine(model, params, num_slots=args.slots,
-                         max_queue=args.max_queue or args.requests,
-                         eos_id=args.eos_id, tracer=tracer)
+    engine = ServeEngine(
+        model, params, num_slots=args.slots,
+        max_queue=args.max_queue or args.requests,
+        eos_id=args.eos_id, tracer=tracer,
+        prefill_chunk_tokens=args.prefill_chunk_tokens or None,
+        prefix_cache_mb=args.prefix_cache_mb or None)
     exporter = None
     if args.metrics_port is not None:
         from k8s_distributed_deeplearning_tpu.telemetry import bridge
@@ -100,9 +133,11 @@ def main(argv: list[str] | None = None) -> int:
         registry = MetricsRegistry()
         bridge.serving_collector(registry, engine.stats)
         exporter = MetricsExporter(registry, port=args.metrics_port).start()
+    shared = rng.integers(0, cfg.vocab_size, size=args.shared_prefix_len)
     for i in range(args.requests):
         prompt = rng.integers(0, cfg.vocab_size,
                               size=int(rng.integers(p_lo, p_hi + 1)))
+        prompt = np.concatenate([shared, prompt])
         engine.submit(Request(
             prompt=prompt.astype(np.int32),
             max_new_tokens=int(rng.integers(o_lo, o_hi + 1)),
@@ -110,12 +145,13 @@ def main(argv: list[str] | None = None) -> int:
 
     # Drive iteration-by-iteration so completions stream out as they
     # happen — the same loop a network front-end would run.
-    while len(engine.queue) or any(s is not None for s in engine._slots):
+    while engine.busy():
         for out in engine.step():
             logger.emit("serve_request", request_id=out.request_id,
                         prompt_len=out.prompt_len,
                         new_tokens=len(out.tokens),
                         finish_reason=out.finish_reason,
+                        cached_prompt_tokens=out.cached_prompt_tokens,
                         queue_ms=round(out.queue_s * 1e3, 3),
                         ttft_ms=(round(out.ttft_s * 1e3, 3)
                                  if out.ttft_s is not None else None),

@@ -1,4 +1,5 @@
-"""Continuous-batching engine: ONE compiled decode step over a slot arena.
+"""Continuous-batching engine: ONE compiled decode step over a slot arena,
+with prefix-reuse KV caching and chunked prefill on the admission path.
 
 Design contract (the compile-once discipline that makes in-flight admission
 free):
@@ -14,12 +15,33 @@ free):
   lifetimes inside one program. It compiles exactly once and reruns for
   every serving iteration regardless of admissions or completions —
   asserted via jit cache-size instrumentation in tests/test_serve.py.
-- Admission (slot freed by EOS / length cap / startup): the next queued
-  request prefills on a right-padded ``[1, bucket]`` prompt through the
-  ordinary shared-cursor decode path (one compile per power-of-two length
-  bucket), and the resulting single-row cache is spliced into the freed
-  slot with ``dynamic_update_slice``. The slot rejoins the decode batch on
-  the next iteration — no drain, no recompile.
+- Admission builds a SINGLE-ROW prefill cache per request and splices it
+  into the freed slot with ``dynamic_update_slice``; the slot rejoins the
+  decode batch on the next iteration — no drain, no recompile. The row
+  cache is filled from up to three sources, all shape-static:
+
+  1. **Prefix cache** (``prefix_cache_mb``): the longest trie-cached prefix
+     of the prompt is PASTED block-by-block (``_paste_program``, one
+     compile) instead of recomputed — serve/prefix_cache.py owns the trie,
+     LRU eviction, and the refcounts that pin a matched segment until its
+     splice lands. Completed prefills insert their prompt KV back
+     (``_copyout_program``), so a fleet-wide system prompt is prefilled
+     once, not N times.
+  2. **Intermediate chunks** (``prefill_chunk_tokens``): the uncached
+     suffix is carved into exact C-token chunks (``_chunk_program``, one
+     compile per C) resumed across engine iterations, each iteration's
+     prefill work budgeted to C real tokens — a 4k prompt no longer
+     freezes the other slots' token streams between two of their tokens.
+  3. **Final chunk** (``_final_chunk_program``, one compile per
+     power-of-two bucket): finishes the suffix and samples the first
+     token. When the remaining tail would need right-padding at a nonzero
+     start (``dynamic_update_slice`` CLAMPS out-of-range starts — a
+     padded tail chunk at the sequence end would write misaligned), the
+     engine instead re-feeds the last ``bucket`` REAL tokens with the
+     cursor rewound: recomputed KV is bit-identical to what it overwrites
+     (same tokens, same absolute positions), so the overlap is idempotent
+     and costs at most one extra bucket of compute.
+
 - Stale-KV safety: columns beyond a slot's cursor are never attended, and
   decode writes land at the cursor BEFORE attention reads, so freed slots
   are reusable without clearing and right-pad garbage in the prefill
@@ -29,9 +51,11 @@ free):
   sampling across slots never recompiles.
 
 Greedy decoding through this engine is token-identical to one-shot
-``generate()`` for the same prompt: prefill runs at the arena's full cache
-width and the per-row slot mask selects exactly the columns the shared
-cursor would (parity asserted in tests/test_serve.py).
+``generate()`` for the same prompt — on the cold path, the prefix-hit path
+AND the chunked-prefill path: KV projections are per-token, the attended
+region per position is independent of how the prompt was fed, and masked
+columns contribute exactly zero (parity asserted in tests/test_serve.py
+and tests/test_prefix_cache.py).
 """
 from __future__ import annotations
 
@@ -45,6 +69,7 @@ import numpy as np
 
 from k8s_distributed_deeplearning_tpu import faults as _faults
 from k8s_distributed_deeplearning_tpu.models import generate
+from k8s_distributed_deeplearning_tpu.serve.prefix_cache import PrefixCache
 from k8s_distributed_deeplearning_tpu.serve.request import (
     Request, RequestOutput)
 from k8s_distributed_deeplearning_tpu.serve.scheduler import RequestQueue
@@ -107,15 +132,37 @@ def _decode_program(model, params: PyTree, cache: PyTree, tokens: jax.Array,
     return nxt, keys, cache
 
 
-@functools.partial(jax.jit, static_argnames=("model",))
-def _prefill_program(model, params: PyTree, prompt: jax.Array,
-                     length: jax.Array, temp: jax.Array, top_k: jax.Array,
-                     top_p: jax.Array, key: jax.Array):
-    """Prefill a right-padded [1, bucket] prompt at the arena's full cache
-    width and sample the first token from column ``length - 1`` (the
-    length is a traced operand — one compile per bucket, not per prompt
-    length). Right padding is causal-safe: real token i attends 0..i."""
-    logits, cache = generate.prefill(model, params, prompt)
+def _leaf_name(path) -> str | None:
+    """Name of a cache leaf from its tree path (DictKey at the tail for
+    both unrolled and layer-scanned layouts)."""
+    return getattr(path[-1], "key", None)
+
+
+@functools.partial(jax.jit, static_argnames=("model",),
+                   donate_argnames=("cache",))
+def _chunk_program(model, params: PyTree, cache: PyTree, chunk: jax.Array):
+    """One INTERMEDIATE prefill chunk: append ``chunk`` ([1, C], all real
+    tokens — never padded, the cursor must advance exactly C) at the row
+    cache's cursor. Logits are discarded, so XLA dead-code-eliminates the
+    lm_head matmul for every chunk but the final one. One compile per C."""
+    _, cache = generate.prefill_chunk(model, params, cache, chunk)
+    return cache
+
+
+@functools.partial(jax.jit, static_argnames=("model",),
+                   donate_argnames=("cache",))
+def _final_chunk_program(model, params: PyTree, cache: PyTree,
+                         chunk: jax.Array, start: jax.Array,
+                         length: jax.Array, temp: jax.Array,
+                         top_k: jax.Array, top_p: jax.Array, key: jax.Array):
+    """Finish a prefill: run ``chunk`` ([1, bucket]) at cache position
+    ``start`` and sample the first token from the last real column
+    ``length - 1`` (both traced operands — one compile per bucket, not per
+    prompt length). With an empty starting cache, ``start=0`` and a
+    right-padded prompt this IS the whole prefill (the cold path); with a
+    pre-filled cache it resumes/overlaps per the module contract above."""
+    logits, cache = generate.prefill_chunk(model, params, cache, chunk,
+                                           start=start)
     last = jax.lax.dynamic_slice_in_dim(logits, length - 1, 1, axis=1)[:, 0, :]
     new_key, tok = _sample_slots(last, temp[None], top_k[None], top_p[None],
                                  key[None])
@@ -143,10 +190,48 @@ def _splice_program(arena: PyTree, pre: PyTree, slot: jax.Array) -> PyTree:
     return jax.tree.map(leaf, arena, pre)
 
 
+@functools.partial(jax.jit, donate_argnames=("cache",))
+def _paste_program(cache: PyTree, segs: list, start: jax.Array) -> PyTree:
+    """Paste ONE cached block's KV slivers (``segs``: the cached_key /
+    cached_value slices in cache-flatten order, seq dim = block) into a
+    single-row prefill cache at position ``start`` (traced — one compile
+    total) and advance the shared cursor to ``start + block`` so a
+    subsequent chunk resumes right after the pasted prefix."""
+    block = segs[0].shape[-2]
+    it = iter(segs)
+
+    def leaf(path, a):
+        name = _leaf_name(path)
+        if name in ("cached_key", "cached_value"):
+            seg = next(it)
+            return jax.lax.dynamic_update_slice_in_dim(
+                a, seg.astype(a.dtype), start, axis=a.ndim - 2)
+        if name == "cache_index":
+            return jnp.full(a.shape, start + block, a.dtype)
+        return a
+
+    return jax.tree_util.tree_map_with_path(leaf, cache)
+
+
+@functools.partial(jax.jit, static_argnames=("block",))
+def _copyout_program(cache: PyTree, start: jax.Array, *, block: int) -> list:
+    """Slice one ``block``-token KV segment out of a completed prefill
+    cache (cached_key/cached_value leaves, flatten order — the inverse of
+    :func:`_paste_program`). NOT donated: the same cache is sliced once
+    per new trie block and then spliced into the arena."""
+    out = []
+    for path, a in jax.tree_util.tree_flatten_with_path(cache)[0]:
+        if _leaf_name(path) in ("cached_key", "cached_value"):
+            out.append(jax.lax.dynamic_slice_in_dim(a, start, block,
+                                                    axis=a.ndim - 2))
+    return out
+
+
 class _InFlight:
     """Host-side record for the request occupying a slot."""
 
-    __slots__ = ("req", "tokens", "t_submit", "t_admit", "t_first")
+    __slots__ = ("req", "tokens", "t_submit", "t_admit", "t_first",
+                 "cached_prompt_tokens")
 
     def __init__(self, req: Request, first_token: int, t_admit: float):
         self.req = req
@@ -154,6 +239,29 @@ class _InFlight:
         self.t_submit = req._t_submit if req._t_submit is not None else t_admit
         self.t_admit = t_admit
         self.t_first = t_admit
+        self.cached_prompt_tokens = 0
+
+
+class _PendingPrefill:
+    """Host-side record for a slot whose prompt is still being prefilled
+    (reserved: not decodable yet, not admittable either). ``pos`` is the
+    prefill cursor — prompt tokens [0, pos) are already in ``cache``
+    (pasted prefix + completed chunks); ``nodes`` pins the trie segments
+    backing the pasted region until the splice lands."""
+
+    __slots__ = ("req", "prompt", "n", "cache", "pos", "hit_tokens",
+                 "nodes", "t_pop")
+
+    def __init__(self, req: Request, prompt: np.ndarray, cache: PyTree,
+                 pos: int, hit_tokens: int, nodes: list, t_pop: float):
+        self.req = req
+        self.prompt = prompt
+        self.n = int(prompt.shape[0])
+        self.cache = cache
+        self.pos = pos
+        self.hit_tokens = hit_tokens
+        self.nodes = nodes
+        self.t_pop = t_pop
 
 
 class ServeEngine:
@@ -161,20 +269,29 @@ class ServeEngine:
 
     Usage::
 
-        eng = ServeEngine(model, params, num_slots=8, eos_id=2)
+        eng = ServeEngine(model, params, num_slots=8, eos_id=2,
+                          prefix_cache_mb=64, prefill_chunk_tokens=128)
         eng.submit(Request(prompt=[...], max_new_tokens=64))
         outputs = eng.run()          # drain queue + in-flight to completion
 
     or drive iteration-by-iteration with :meth:`step` (each call = one
-    decode iteration preceded by admissions into any free slots) and stream
+    decode iteration preceded by bounded admission/prefill work) and stream
     tokens via ``Request.on_token``. ``num_slots >= 2`` (a 1-slot arena is
     not batched serving, and slot-axis splicing needs a distinguishable
     batch axis).
+
+    ``prefix_cache_mb`` (None/0 = off) bounds the rank-local prefix-reuse
+    trie; ``prefill_chunk_tokens`` (None = off) bounds each iteration's
+    prefill work to that many real prompt tokens (must be a positive
+    multiple of ``min_bucket``, the prefill bucket granularity).
     """
 
     def __init__(self, model, params: PyTree, *, num_slots: int = 8,
                  max_queue: int = 256, eos_id: int | None = None,
                  pad_id: int = 0, min_bucket: int = 32,
+                 prefill_chunk_tokens: int | None = None,
+                 prefix_cache_mb: float | None = None,
+                 prefix_block_tokens: int | None = None,
                  stats: ServingStats | None = None,
                  tracer: Tracer | None = None):
         if num_slots < 2:
@@ -184,6 +301,18 @@ class ServeEngine:
         if max_seq is None:
             raise ValueError("model.cfg.max_seq_len is required — it sizes "
                              "the KV arena")
+        if prefill_chunk_tokens is not None and (
+                prefill_chunk_tokens < min_bucket
+                or prefill_chunk_tokens % min_bucket):
+            raise ValueError(
+                f"prefill_chunk_tokens ({prefill_chunk_tokens}) must be a "
+                f"positive multiple of min_bucket ({min_bucket}) — chunks "
+                "are real-token slices aligned to the prefill bucket "
+                "granularity")
+        if prefix_cache_mb is not None and prefix_cache_mb < 0:
+            raise ValueError(
+                f"prefix_cache_mb must be >= 0 (0 = off), got "
+                f"{prefix_cache_mb}")
         self.model = model
         self.params = params
         self.num_slots = num_slots
@@ -191,10 +320,12 @@ class ServeEngine:
         self.eos_id = eos_id
         self.pad_id = pad_id
         self.min_bucket = min_bucket
+        self.prefill_chunk_tokens = prefill_chunk_tokens
         self.stats = stats if stats is not None else ServingStats()
-        # Spans: "admission" (queue pop -> slot occupied, wrapping a
-        # "prefill" for the compiled prefill + splice) and "decode" (one
-        # arena-wide decode iteration incl. the host sync).
+        # Spans: "admission" (queue pop -> pending created, wrapping the
+        # prefix lookup + paste), "prefill" (one compiled chunk / final
+        # chunk + splice) and "decode" (one arena-wide decode iteration
+        # incl. the host sync).
         self.tracer = tracer if tracer is not None else _NULL_TRACER
         self.queue = RequestQueue(max_queue)
         # Per-slot register file (host numpy; fixed dtypes so the decode
@@ -207,7 +338,32 @@ class ServeEngine:
         self._top_ps = np.ones(num_slots, np.float32)
         self._keys = np.zeros((num_slots, 2), np.uint32)
         self._slots: list[_InFlight | None] = [None] * num_slots
+        self._pending: dict[int, _PendingPrefill] = {}
         self._cache = self._init_arena()
+        # Single-request row-cache template (eval_shape: no FLOPs) — each
+        # admission materializes a fresh one to fill from pasted prefix +
+        # chunks. cached_seg MUST init to ones: the shared-cursor decode
+        # branch's safety-net mask hides columns whose seg id is 0, which
+        # on a zero-filled cache would hide the entire written prefix.
+        dummy = jnp.zeros((1, 1), jnp.int32)
+        _, self._row_shapes = jax.eval_shape(
+            lambda p, t: generate.prefill(self.model, p, t),
+            self.params, dummy)
+        self.prefix_cache: PrefixCache | None = None
+        if prefix_cache_mb is not None and prefix_cache_mb > 0:
+            bt = (prefix_block_tokens if prefix_block_tokens is not None
+                  else min_bucket)
+            if bt < 1 or bt > self.max_seq_len:
+                raise ValueError(
+                    f"prefix_block_tokens ({bt}) must be in "
+                    f"[1, max_seq_len={self.max_seq_len}]")
+            self.prefix_cache = PrefixCache(
+                int(prefix_cache_mb * 2 ** 20), block_tokens=bt,
+                block_nbytes=self._block_nbytes(bt))
+        # Per-step accounting for the chunked-prefill work bound (tested:
+        # real prefill tokens per iteration never exceed the chunk budget).
+        self.last_step_prefill_tokens = 0
+        self._step_prefill_budget: int | None = None
 
     def _init_arena(self) -> PyTree:
         """Zero-filled arena with the exact leaf structure a prefill
@@ -218,6 +374,25 @@ class ServeEngine:
             lambda p, t: generate.prefill(self.model, p, t),
             self.params, dummy)
         return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes)
+
+    def _block_nbytes(self, block_tokens: int) -> int:
+        """Bytes of KV one trie block owns (seq dim of every cached_key/
+        cached_value leaf cut to block_tokens) — lets the prefix cache
+        answer "would this block fit" before any device copy."""
+        total = 0
+        for path, s in jax.tree_util.tree_flatten_with_path(
+                self._row_shapes)[0]:
+            if _leaf_name(path) in ("cached_key", "cached_value"):
+                per_pos = int(np.prod(s.shape)) // s.shape[-2]
+                total += per_pos * block_tokens * s.dtype.itemsize
+        return total
+
+    def _fresh_row_cache(self) -> PyTree:
+        def leaf(path, s):
+            if _leaf_name(path) == "cached_seg":
+                return jnp.ones(s.shape, s.dtype)
+            return jnp.zeros(s.shape, s.dtype)
+        return jax.tree_util.tree_map_with_path(leaf, self._row_shapes)
 
     # ---------------------------------------------------------------- API
 
@@ -239,17 +414,27 @@ class ServeEngine:
         self.queue.submit(req)
         return req.request_id
 
+    def busy(self) -> bool:
+        """True while any work remains: queued requests, prefills in
+        progress, or occupied decode slots. THE loop condition for
+        callers driving :meth:`step` (in-progress prefills hold no slot
+        entry, so checking queue+slots alone would exit early)."""
+        return bool(len(self.queue) or self._pending
+                    or any(s is not None for s in self._slots))
+
     def step(self) -> list[RequestOutput]:
         """One serving iteration: admit queued requests into free slots,
-        then advance every occupied slot one token. Returns the requests
-        that finished during this iteration (possibly at admission, when
-        the first token is already EOS or ``max_new_tokens == 1``).
+        run at most ``prefill_chunk_tokens`` real tokens of prefill work
+        (unlimited when chunking is off), then advance every occupied slot
+        one token. Returns the requests that finished during this
+        iteration (possibly at admission, when the first token is already
+        EOS or ``max_new_tokens == 1``).
 
         Deadline enforcement happens here, at the decode boundary: an
-        occupied slot whose request's ``deadline_s`` has expired is
-        cancelled FIRST (finish_reason "timeout", slot freed — so the
-        admission pass below can reuse it this very iteration), and an
-        expired request popped from the queue completes as "timeout"
+        occupied or mid-prefill slot whose request's ``deadline_s`` has
+        expired is cancelled FIRST (finish_reason "timeout", slot freed —
+        so the admission pass below can reuse it this very iteration), and
+        an expired request popped from the queue completes as "timeout"
         without ever prefilling. A hung client therefore costs at most
         one decode iteration of slot time past its own budget, and never
         stalls the other slots."""
@@ -258,16 +443,20 @@ class ServeEngine:
         for slot, fl in enumerate(self._slots):
             if fl is not None and self._expired(fl.req, now):
                 outputs.append(self._finish(slot, "timeout"))
-        for slot in range(self.num_slots):
-            while self._slots[slot] is None and len(self.queue):
-                req = self.queue.pop()
-                if self._expired(req, time.perf_counter()):
-                    outputs.append(self._timeout_unadmitted(req))
-                    continue        # expired in queue; try the next one
-                done = self._admit(slot, req)
-                if done is None:
-                    break           # slot occupied; next slot
-                outputs.append(done)  # finished at admission; slot still free
+        for slot in list(self._pending):
+            if self._expired(self._pending[slot].req, now):
+                outputs.append(self._cancel_pending(slot, "timeout"))
+        self.last_step_prefill_tokens = 0
+        self._step_prefill_budget = self.prefill_chunk_tokens
+        # Admission and prefill alternate until neither makes progress:
+        # a request that finishes AT admission (first token is EOS /
+        # max_new_tokens == 1) frees its slot for the next queued request
+        # within the same iteration, budget permitting.
+        while True:
+            self._admit_free_slots(outputs)
+            freed = self._run_prefills(outputs)
+            if not (freed and len(self.queue)):
+                break
         active = sum(s is not None for s in self._slots)
         if active == 0:
             return outputs
@@ -304,14 +493,14 @@ class ServeEngine:
 
     def run(self, requests: Iterable[Request] | None = None,
             max_steps: int | None = None) -> list[RequestOutput]:
-        """Submit *requests* (optional) and step until queue and slots are
-        empty. Returns outputs in completion order."""
+        """Submit *requests* (optional) and step until queue, prefills and
+        slots are all drained. Returns outputs in completion order."""
         if requests is not None:
             for r in requests:
                 self.submit(r)
         outputs: list[RequestOutput] = []
         steps = 0
-        while len(self.queue) or any(s is not None for s in self._slots):
+        while self.busy():
             outputs.extend(self.step())
             steps += 1
             if max_steps is not None and steps >= max_steps:
@@ -319,9 +508,10 @@ class ServeEngine:
         return outputs
 
     def shutdown(self) -> list[RequestOutput]:
-        """Abort everything: queued requests (no tokens) and in-flight
-        requests (partial tokens) all complete with finish_reason
-        "aborted". The engine is reusable afterwards."""
+        """Abort everything: queued requests (no tokens), mid-prefill
+        requests (pinned trie segments released) and in-flight requests
+        (partial tokens) all complete with finish_reason "aborted". The
+        engine is reusable afterwards."""
         outs: list[RequestOutput] = []
         now = time.perf_counter()
         for req in self.queue.drain():
@@ -332,6 +522,8 @@ class ServeEngine:
                 ttft_s=None, latency_s=now - t0))
             if req.on_finish is not None:
                 req.on_finish("aborted")
+        for slot in list(self._pending):
+            outs.append(self._cancel_pending(slot, "aborted"))
         for slot, fl in enumerate(self._slots):
             if fl is not None:
                 outs.append(self._finish(slot, "aborted"))
@@ -345,8 +537,15 @@ class ServeEngine:
 
     @staticmethod
     def prefill_cache_size() -> int:
-        """Compiled-program count of the prefill step (≤ one per bucket)."""
-        return _prefill_program._cache_size()
+        """Compiled-program count of the final-chunk prefill step (≤ one
+        per bucket — the same budget the monolithic prefill had)."""
+        return _final_chunk_program._cache_size()
+
+    @staticmethod
+    def chunk_cache_size() -> int:
+        """Compiled-program count of the intermediate-chunk step (≤ one
+        per distinct chunk width)."""
+        return _chunk_program._cache_size()
 
     # ----------------------------------------------------------- internals
 
@@ -375,27 +574,133 @@ class ServeEngine:
             b *= 2
         return min(b, self.max_seq_len)
 
-    def _admit(self, slot: int, req: Request) -> RequestOutput | None:
-        """Prefill *req* into *slot*. Returns a RequestOutput when the
-        request finished at admission (first token was EOS, or the length
-        budget is a single token) — the slot stays free in that case."""
+    def _admit_free_slots(self, outputs: list[RequestOutput]) -> None:
+        """Pop queued requests into free, non-pending slots (expired ones
+        complete as "timeout" without costing prefill)."""
+        for slot in range(self.num_slots):
+            while (self._slots[slot] is None and slot not in self._pending
+                   and len(self.queue)):
+                req = self.queue.pop()
+                if self._expired(req, time.perf_counter()):
+                    outputs.append(self._timeout_unadmitted(req))
+                    continue        # expired in queue; try the next one
+                self._begin_admission(slot, req)
+                break
+
+    def _begin_admission(self, slot: int, req: Request) -> None:
+        """Reserve *slot* for *req*: build its row cache, paste the longest
+        trie-cached prefix (pinning the matched segments), and park it as a
+        pending prefill for :meth:`_run_prefills` to advance."""
         n = len(req.prompt)
+        t_pop = time.perf_counter()
+        prompt = np.asarray(req.prompt, np.int32)
+        hit, nodes = 0, []
         with self.tracer.span("admission", prompt_len=n, slot=slot):
-            bucket = self._bucket(n)
-            padded = np.full((1, bucket), self.pad_id, np.int32)
-            padded[0, :n] = np.asarray(req.prompt, np.int32)
-            sp = req.sampling
-            with self.tracer.span("prefill", bucket=bucket):
-                tok, key, pre = _prefill_program(
-                    self.model, self.params, padded, np.int32(n),
-                    np.float32(sp.temperature), np.int32(sp.top_k),
-                    np.float32(sp.top_p),
-                    np.asarray(jax.random.PRNGKey(req.seed), np.uint32))
-                self._cache = _splice_program(self._cache, pre,
-                                              np.int32(slot))
-                first = int(tok)
+            cache = self._fresh_row_cache()
+            if self.prefix_cache is not None:
+                hit, nodes = self.prefix_cache.acquire(prompt.tolist())
+                self.stats.record_prefix_lookup(hit, n)
+                bt = self.prefix_cache.block_tokens
+                for j, node in enumerate(nodes):
+                    cache = _paste_program(cache, node.kv, np.int32(j * bt))
+        self._pending[slot] = _PendingPrefill(req, prompt, cache, hit, hit,
+                                              nodes, t_pop)
+        t0 = req._t_submit if req._t_submit is not None else t_pop
+        self.stats.record_admission(queue_s=t_pop - t0, prompt_len=n)
+
+    def _run_prefills(self, outputs: list[RequestOutput]) -> bool:
+        """Advance pending prefills FIFO within this step's token budget.
+        Intermediate chunks are exact C-token slices; the final chunk
+        (bucketed) completes the admission. Returns True when a request
+        finished AT admission and freed its slot."""
+        freed = False
+        for slot in list(self._pending):
+            pend = self._pending.get(slot)
+            c = self.prefill_chunk_tokens
+            while pend is not None:
+                rem = pend.n - pend.pos
+                budget = self._step_prefill_budget
+                if c is not None and rem > c:
+                    if budget is not None and budget < c:
+                        break       # out of budget; resume next iteration
+                    chunk = pend.prompt[None, pend.pos:pend.pos + c]
+                    with self.tracer.span("prefill", chunk=c, slot=slot):
+                        pend.cache = _chunk_program(
+                            self.model, self.params, pend.cache,
+                            np.ascontiguousarray(chunk))
+                    pend.pos += c
+                    self._charge_prefill(c)
+                    continue
+                if budget is not None and rem > budget:
+                    break
+                out = self._finish_admission(slot, pend)
+                self._charge_prefill(rem)
+                if out is not None:
+                    outputs.append(out)
+                    freed = True
+                pend = None
+        return freed
+
+    def _charge_prefill(self, tokens: int) -> None:
+        self.last_step_prefill_tokens += int(tokens)
+        if self._step_prefill_budget is not None:
+            self._step_prefill_budget = max(
+                0, self._step_prefill_budget - int(tokens))
+
+    def _finish_admission(self, slot: int,
+                          pend: _PendingPrefill) -> RequestOutput | None:
+        """Run the final (sampling) chunk, insert the prompt's KV into the
+        trie, splice the row cache into the arena and activate the slot.
+        Returns a RequestOutput when the request finished at admission
+        (first token was EOS, or the length budget is a single token) —
+        the slot stays free in that case."""
+        req, n = pend.req, pend.n
+        rem = n - pend.pos
+        bucket = self._bucket(rem)
+        sp = req.sampling
+        if n >= bucket:
+            # All-real tail: re-feed the last `bucket` prompt tokens with
+            # the cursor rewound to n - bucket. The overlapped positions
+            # rewrite KV bit-identical to what's already there (same
+            # tokens, same absolute positions) — never writes past n, so
+            # dynamic_update_slice can't clamp-misalign.
+            start = n - bucket
+            chunk = np.ascontiguousarray(pend.prompt[None, start:])
+            last = bucket
+        else:
+            # Short prompt (shorter than the smallest bucket that fits its
+            # remainder): right-pad from position 0 — the cold path.
+            start = 0
+            chunk = np.full((1, bucket), self.pad_id, np.int32)
+            chunk[0, :n] = pend.prompt
+            last = n
+        with self.tracer.span("prefill", bucket=bucket, slot=slot,
+                              cached=pend.hit_tokens):
+            tok, key, pre = _final_chunk_program(
+                self.model, self.params, pend.cache, chunk, np.int32(start),
+                np.int32(last), np.float32(sp.temperature),
+                np.int32(sp.top_k), np.float32(sp.top_p),
+                np.asarray(jax.random.PRNGKey(req.seed), np.uint32))
+            if self.prefix_cache is not None:
+                # Insert BEFORE the splice: _splice_program donates `pre`.
+                # Copy-out runs only for blocks the trie doesn't hold (and
+                # never when the budget can't fit a block).
+                bt = self.prefix_cache.block_tokens
+                _, evicted = self.prefix_cache.insert(
+                    pend.prompt.tolist(),
+                    lambda i: _copyout_program(pre, np.int32(i * bt),
+                                               block=bt))
+                if evicted:
+                    self.stats.record_prefix_evictions(evicted)
+                self.prefix_cache.release(pend.nodes)
+                pend.nodes = []
+            self._cache = _splice_program(self._cache, pre, np.int32(slot))
+            first = int(tok)
+        del self._pending[slot]
         now = time.perf_counter()
         fl = _InFlight(req, first, now)
+        fl.t_admit = pend.t_pop
+        fl.cached_prompt_tokens = pend.hit_tokens
         self._slots[slot] = fl
         self._tokens[slot] = first
         self._kv_lens[slot] = n          # next write position
@@ -403,7 +708,6 @@ class ServeEngine:
         self._top_ks[slot] = sp.top_k
         self._top_ps[slot] = sp.top_p
         self._keys[slot] = np.asarray(key)
-        self.stats.record_admission(queue_s=now - fl.t_submit, prompt_len=n)
         self.stats.record_first_token(ttft_s=now - fl.t_submit)
         if req.on_token is not None:
             req.on_token(first)
@@ -413,6 +717,26 @@ class ServeEngine:
             return self._finish(slot, "length")
         return None
 
+    def _cancel_pending(self, slot: int, reason: str) -> RequestOutput:
+        """Terminal output for a request cancelled mid-prefill (deadline /
+        shutdown): release its pinned trie segments, free the slot."""
+        pend = self._pending.pop(slot)
+        if self.prefix_cache is not None and pend.nodes:
+            self.prefix_cache.release(pend.nodes)
+            pend.nodes = []
+        now = time.perf_counter()
+        t0 = (pend.req._t_submit if pend.req._t_submit is not None else now)
+        out = RequestOutput(
+            request_id=pend.req.request_id, prompt_len=pend.n,
+            tokens=[], finish_reason=reason, queue_s=pend.t_pop - t0,
+            ttft_s=None, latency_s=now - t0,
+            cached_prompt_tokens=pend.hit_tokens)
+        self.stats.record_completion(latency_s=out.latency_s, n_tokens=0,
+                                     reason=reason)
+        if pend.req.on_finish is not None:
+            pend.req.on_finish(reason)
+        return out
+
     def _finish(self, slot: int, reason: str) -> RequestOutput:
         fl = self._slots[slot]
         now = time.perf_counter()
@@ -421,7 +745,8 @@ class ServeEngine:
             tokens=list(fl.tokens), finish_reason=reason,
             queue_s=fl.t_admit - fl.t_submit,
             ttft_s=fl.t_first - fl.t_submit,
-            latency_s=now - fl.t_submit)
+            latency_s=now - fl.t_submit,
+            cached_prompt_tokens=fl.cached_prompt_tokens)
         self._slots[slot] = None
         self._tokens[slot] = self.pad_id
         self._kv_lens[slot] = 0

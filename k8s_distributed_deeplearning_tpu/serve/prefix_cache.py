@@ -1,0 +1,213 @@
+"""Prefix-reuse KV cache: a token-block trie with LRU eviction under a
+byte budget (SGLang's RadixAttention idea, restricted to fixed-size blocks
+so every cached segment splices with ONE compiled paste program).
+
+Real serving traffic shares prompt prefixes — a fleet-wide system prompt,
+few-shot templates, multi-turn histories — and the engine used to burn
+prefill FLOPs recomputing the identical KV for every request. This module
+memoizes prompt KV **rank-locally** at block granularity:
+
+- The trie is keyed on *token blocks*: each edge is a tuple of exactly
+  ``block_tokens`` token ids, so a node at depth d caches the KV for the
+  first ``d * block_tokens`` tokens of any prompt reaching it. Block
+  granularity keeps the splice/copy-out programs shape-static (one compile
+  each) and makes partial-prefix hits natural: a request matching 3 of its
+  5 blocks prefills only the tail.
+- Each node OWNS its KV segment: the ``cached_key``/``cached_value``
+  slivers (``[..., block_tokens, kv*head_dim]``, the engine's folded-head
+  decode layout) for its block's positions. Absolute positions make this
+  sound for RoPE models: position enters K at projection time, so the
+  cached K for positions [s, s+block) is reusable verbatim by any prompt
+  sharing those exact tokens at those exact offsets — which is precisely
+  what trie membership guarantees.
+- Eviction is LRU over *leaf* nodes only (evicting an interior node would
+  orphan the descendants that extend its prefix) under ``capacity_bytes``.
+  A node pinned by an in-flight admission (``refs > 0``) is never evicted:
+  the engine acquires the matched path at lookup and releases it after the
+  KV has been spliced into the request's prefill cache, so eviction can
+  never free a segment a pending splice still reads. Interior nodes are
+  protected transitively — they have children by definition.
+
+The cache stores device arrays; byte accounting uses the arrays' nominal
+``nbytes`` (the engine passes ``block_nbytes`` so "would it fit" is
+answerable before paying the copy-out).
+"""
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, Sequence
+
+
+class _Node:
+    """One cached block: ``key`` is its token tuple, ``kv`` the list of
+    per-leaf KV slivers (flatten order of the engine's cache pytree)."""
+
+    __slots__ = ("key", "parent", "children", "kv", "nbytes", "refs",
+                 "last_used")
+
+    def __init__(self, key, parent, kv, nbytes, stamp):
+        self.key = key
+        self.parent = parent
+        self.children: dict[tuple, "_Node"] = {}
+        self.kv = kv
+        self.nbytes = nbytes
+        self.refs = 0
+        self.last_used = stamp
+
+
+class PrefixCache:
+    """Token-block trie of KV segments with refcounts and LRU eviction.
+
+    ``capacity_bytes <= 0`` still constructs (an always-empty cache — every
+    insert is rejected before any copy-out), which is how the "enabled but
+    empty" overhead gate isolates pure bookkeeping cost.
+    """
+
+    def __init__(self, capacity_bytes: int, block_tokens: int = 32,
+                 block_nbytes: int | None = None):
+        if block_tokens < 1:
+            raise ValueError(
+                f"block_tokens must be >= 1, got {block_tokens}")
+        self.capacity_bytes = int(capacity_bytes)
+        self.block_tokens = int(block_tokens)
+        # Size of one block's KV, known up front so insert() can test fit
+        # (and skip) BEFORE paying the device copy-out for the segment.
+        self.block_nbytes = block_nbytes
+        self.used_bytes = 0
+        self._root = _Node(None, None, None, 0, -1)
+        self._nodes: list[_Node] = []
+        self._clock = itertools.count()
+        # Counters (monotonic; the engine mirrors deltas into ServingStats).
+        self.hits = 0                  # lookups that matched >= 1 block
+        self.misses = 0                # lookups that matched nothing
+        self.hit_tokens = 0
+        self.lookup_tokens = 0
+        self.evictions = 0             # blocks evicted
+        self.inserted_blocks = 0
+        self.skipped_blocks = 0        # insert candidates that didn't fit
+
+    def __len__(self) -> int:
+        return len(self._nodes)
+
+    # -------------------------------------------------------------- lookup
+
+    def _key(self, tokens: Sequence[int], i: int) -> tuple:
+        b = self.block_tokens
+        return tuple(int(t) for t in tokens[i * b:(i + 1) * b])
+
+    def acquire(self, tokens: Sequence[int],
+                max_tokens: int | None = None) -> tuple[int, list[_Node]]:
+        """Longest cached prefix of *tokens* in whole blocks, capped at
+        ``max_tokens`` (default ``len(tokens) - 1`` — at least one prompt
+        token must always be prefilled so the engine has logits to sample
+        the first output token from). Pins every matched node (``refs`` +1)
+        and touches it for LRU. Returns ``(hit_tokens, pinned_nodes)``;
+        the caller MUST :meth:`release` the nodes once the KV is spliced.
+        """
+        limit = len(tokens) - 1 if max_tokens is None else max_tokens
+        node, nodes, pos, i = self._root, [], 0, 0
+        while pos + self.block_tokens <= limit:
+            child = node.children.get(self._key(tokens, i))
+            if child is None:
+                break
+            child.refs += 1
+            child.last_used = next(self._clock)
+            nodes.append(child)
+            node, pos, i = child, pos + self.block_tokens, i + 1
+        if pos:
+            self.hits += 1
+        else:
+            self.misses += 1
+        self.hit_tokens += pos
+        self.lookup_tokens += len(tokens)
+        return pos, nodes
+
+    def release(self, nodes: list[_Node]) -> None:
+        for nd in nodes:
+            if nd.refs <= 0:
+                raise RuntimeError("release() without a matching acquire()")
+            nd.refs -= 1
+
+    # -------------------------------------------------------------- insert
+
+    def insert(self, tokens: Sequence[int],
+               kv_for_block: Callable[[int], list[Any]]) -> tuple[int, int]:
+        """Insert every whole block of *tokens* not already cached, calling
+        ``kv_for_block(i)`` (→ list of per-leaf slivers) only for NEW blocks
+        — already-present blocks are just LRU-touched, so re-serving a hot
+        prefix costs no device copies. Blocks that cannot fit even after
+        eviction are skipped (and the walk stops: a child without its
+        parent chain would be unreachable). Returns
+        ``(new_blocks, evicted_blocks)``.
+        """
+        node, new = self._root, 0
+        for i in range(len(tokens) // self.block_tokens):
+            key = self._key(tokens, i)
+            child = node.children.get(key)
+            if child is None:
+                need = self.block_nbytes
+                if need is not None and not self._make_room(need):
+                    self.skipped_blocks += 1
+                    break
+                kv = kv_for_block(i)
+                nbytes = sum(int(a.nbytes) for a in kv)
+                if need is None and not self._make_room(nbytes):
+                    self.skipped_blocks += 1
+                    break
+                child = _Node(key, node, kv, nbytes, next(self._clock))
+                node.children[key] = child
+                self._nodes.append(child)
+                self.used_bytes += nbytes
+                self.inserted_blocks += 1
+                new += 1
+            else:
+                child.last_used = next(self._clock)
+            node = child
+        return new, self._drain_evicted()
+
+    def _make_room(self, need: int) -> bool:
+        """Evict LRU unpinned leaves until *need* bytes fit. False when
+        they can't (budget too small, or everything evictable is pinned)."""
+        if need > self.capacity_bytes:
+            return False
+        while self.used_bytes + need > self.capacity_bytes:
+            victim = None
+            for nd in self._nodes:
+                if nd.children or nd.refs > 0:
+                    continue
+                if victim is None or nd.last_used < victim.last_used:
+                    victim = nd
+            if victim is None:
+                return False
+            self._evict(victim)
+        return True
+
+    def _evict(self, node: _Node) -> None:
+        del node.parent.children[node.key]
+        self._nodes.remove(node)
+        self.used_bytes -= node.nbytes
+        node.kv = None                  # drop the device buffers
+        self.evictions += 1
+        self._evicted_pending = getattr(self, "_evicted_pending", 0) + 1
+
+    def _drain_evicted(self) -> int:
+        n = getattr(self, "_evicted_pending", 0)
+        self._evicted_pending = 0
+        return n
+
+    # ------------------------------------------------------------- stats
+
+    def counters(self) -> dict:
+        return {
+            "blocks": len(self._nodes),
+            "used_bytes": self.used_bytes,
+            "capacity_bytes": self.capacity_bytes,
+            "block_tokens": self.block_tokens,
+            "hits": self.hits,
+            "misses": self.misses,
+            "hit_tokens": self.hit_tokens,
+            "lookup_tokens": self.lookup_tokens,
+            "evictions": self.evictions,
+            "inserted_blocks": self.inserted_blocks,
+            "skipped_blocks": self.skipped_blocks,
+        }

@@ -84,7 +84,9 @@ class RequestOutput:
     or "timeout" (``Request.deadline_s`` expired — cancelled at a decode
     boundary with partial ``tokens``, or straight from the queue with
     none). ``ttft_s`` is None for requests aborted/timed out before
-    their first token.
+    their first token. ``cached_prompt_tokens`` counts the prompt tokens
+    served from the engine's prefix-reuse KV cache instead of being
+    prefilled (0 when the cache is off or missed).
     """
 
     request_id: str
@@ -94,3 +96,4 @@ class RequestOutput:
     queue_s: float
     ttft_s: float | None
     latency_s: float
+    cached_prompt_tokens: int = 0

@@ -5,6 +5,12 @@ first served into whichever slot frees up), and the bound is the
 back-pressure surface — a full queue raises :class:`QueueFull` at submit
 time instead of buffering unboundedly. Priority/fair-share policies would
 slot in here without touching the engine.
+
+With chunked prefill a popped request may spend several engine iterations
+as a *pending prefill* before its slot decodes (serve/engine.py
+``_PendingPrefill``); it has left this queue by then — queue wait is
+measured submit→pop, and ``ServeEngine.busy()`` is the drain condition
+(queue + pendings + slots), not ``len(queue)`` alone.
 """
 from __future__ import annotations
 

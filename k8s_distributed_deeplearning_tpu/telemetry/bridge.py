@@ -116,6 +116,22 @@ def serving_collector(registry: MetricsRegistry,
             "serve_ttft_p95_ms", "time-to-first-token p95"),
         "serve_latency_p95_ms": registry.gauge(
             "serve_latency_p95_ms", "request latency p95"),
+        "serve_queue_p50_ms": registry.gauge(
+            "serve_queue_p50_ms", "admission queue wait p50"),
+        "serve_queue_p95_ms": registry.gauge(
+            "serve_queue_p95_ms", "admission queue wait p95"),
+        "serve_prefix_cache_hits": registry.gauge(
+            "serve_prefix_cache_hits",
+            "admissions that reused >= 1 cached prefix block"),
+        "serve_prefix_cache_misses": registry.gauge(
+            "serve_prefix_cache_misses",
+            "admissions with no cached prefix"),
+        "serve_prefix_cache_evictions": registry.gauge(
+            "serve_prefix_cache_evictions",
+            "prefix-cache KV blocks evicted under the byte budget"),
+        "serve_prefix_hit_rate": registry.gauge(
+            "serve_prefix_hit_rate",
+            "fraction of looked-up prompt tokens served from cached KV"),
     }
     key_map = {"requests_admitted": "serve_requests_admitted",
                "requests_completed": "serve_requests_completed",
@@ -124,7 +140,13 @@ def serving_collector(registry: MetricsRegistry,
                "mean_slot_occupancy": "serve_mean_slot_occupancy",
                "ttft_p50_ms": "serve_ttft_p50_ms",
                "ttft_p95_ms": "serve_ttft_p95_ms",
-               "latency_p95_ms": "serve_latency_p95_ms"}
+               "latency_p95_ms": "serve_latency_p95_ms",
+               "queue_p50_ms": "serve_queue_p50_ms",
+               "queue_p95_ms": "serve_queue_p95_ms",
+               "prefix_cache_hits": "serve_prefix_cache_hits",
+               "prefix_cache_misses": "serve_prefix_cache_misses",
+               "prefix_cache_evictions": "serve_prefix_cache_evictions",
+               "prefix_hit_rate": "serve_prefix_hit_rate"}
 
     def collect() -> None:
         summ = stats.summary()

@@ -38,6 +38,7 @@ class Prefetcher:
         self._q: queue.Queue = queue.Queue(maxsize=depth)
         self._stop = threading.Event()
         self._error: BaseException | None = None
+        self._closed = False
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
 
@@ -84,6 +85,14 @@ class Prefetcher:
         return item
 
     def close(self) -> None:
+        # Idempotent: close() is called from both normal teardown and
+        # finally-block cleanup (close_all), so a second call must be a
+        # no-op — re-draining would steal the sentinel a concurrent
+        # consumer is about to observe, and there is no worker left to
+        # wake or join.
+        if self._closed:
+            return
+        self._closed = True
         self._stop.set()
         # Drain so a blocked put wakes up and the thread can exit.
         try:

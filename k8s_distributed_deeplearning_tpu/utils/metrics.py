@@ -112,6 +112,13 @@ class ServingStats:
         self.ttft_s: list[float] = []
         self.latency_s: list[float] = []
         self.finish_reasons: dict[str, int] = {}
+        # Prefix-reuse KV cache: one lookup per admission (hit = matched
+        # >= 1 block); token counts measure how much prefill was skipped.
+        self.prefix_hits = 0
+        self.prefix_misses = 0
+        self.prefix_hit_tokens = 0
+        self.prefix_lookup_tokens = 0
+        self.prefix_evictions = 0
 
     def _tick(self) -> None:
         now = time.perf_counter()
@@ -134,6 +141,22 @@ class ServingStats:
         self.steps += 1
         self.decode_tokens += active_slots
         self.occupancy_sum += active_slots / max(num_slots, 1)
+
+    def record_prefix_lookup(self, hit_tokens: int,
+                             prompt_tokens: int) -> None:
+        """One prefix-cache lookup at admission: ``hit_tokens`` of the
+        ``prompt_tokens``-long prompt were served from cached KV."""
+        self._tick()
+        if hit_tokens > 0:
+            self.prefix_hits += 1
+        else:
+            self.prefix_misses += 1
+        self.prefix_hit_tokens += hit_tokens
+        self.prefix_lookup_tokens += prompt_tokens
+
+    def record_prefix_evictions(self, n_blocks: int) -> None:
+        self._tick()
+        self.prefix_evictions += n_blocks
 
     def record_completion(self, latency_s: float, n_tokens: int,
                           reason: str) -> None:
@@ -173,8 +196,17 @@ class ServingStats:
             "ttft_p50_ms": _ms(self._pct(self.ttft_s, 0.5)),
             "ttft_p95_ms": _ms(self._pct(self.ttft_s, 0.95)),
             "queue_p50_ms": _ms(self._pct(self.queue_s, 0.5)),
+            "queue_p95_ms": _ms(self._pct(self.queue_s, 0.95)),
             "latency_p50_ms": _ms(self._pct(self.latency_s, 0.5)),
             "latency_p95_ms": _ms(self._pct(self.latency_s, 0.95)),
+            "prefix_cache_hits": self.prefix_hits,
+            "prefix_cache_misses": self.prefix_misses,
+            "prefix_cache_evictions": self.prefix_evictions,
+            # Fraction of looked-up prompt tokens served from cached KV
+            # (None until the first lookup, i.e. cache disabled or idle).
+            "prefix_hit_rate": (
+                round(self.prefix_hit_tokens / self.prefix_lookup_tokens, 4)
+                if self.prefix_lookup_tokens else None),
         }
 
 

@@ -34,7 +34,7 @@ CHUNKS[moe]="tests/test_moe.py"
 CHUNKS[train]="tests/test_mnist_convergence.py tests/test_grad_accum.py tests/test_chunked_ce.py tests/test_checkpoint.py tests/test_data.py tests/test_prefetch.py tests/test_metrics.py tests/test_profiling.py tests/test_fusion.py"
 CHUNKS[llama]="tests/test_train_llama.py tests/test_generate.py"
 CHUNKS[deploy]="tests/test_watch.py tests/test_render.py tests/test_deploy_smoke.py tests/test_elastic.py tests/test_preemption.py tests/test_cluster_e2e.py"
-CHUNKS[serve]="tests/test_serve.py tests/test_telemetry.py tests/test_events_schema.py"
+CHUNKS[serve]="tests/test_serve.py tests/test_prefix_cache.py tests/test_telemetry.py tests/test_events_schema.py"
 # The chaos matrix spawns real training gangs (subprocess per attempt), so
 # it gets its own chunk rather than riding in deploy.
 CHUNKS[faults]="tests/test_faults.py"

@@ -90,3 +90,26 @@ def test_repeated_next_after_exhaustion_keeps_raising():
         with pytest.raises(RuntimeError, match="immediate"):
             next(p2)
     p2.close()
+
+
+def test_double_close_is_idempotent():
+    """close() from both normal teardown and a finally-block (close_all)
+    must be a no-op the second time: no re-drain stealing the sentinel,
+    post-close next() still raises instead of hanging."""
+    p = Prefetcher(iter(range(8)), depth=2)
+    assert next(p) == 0
+    p.close()
+    p.close()                           # explicit double close
+    with p:                             # __exit__ is a third close
+        pass
+    assert not p._thread.is_alive()
+    with pytest.raises(StopIteration):
+        next(p)
+
+    # Exhaust-then-close-twice: the terminal state stays observable.
+    p2 = Prefetcher(iter([1]))
+    assert list(p2) == [1]
+    p2.close()
+    p2.close()
+    with pytest.raises(StopIteration):
+        next(p2)
