@@ -1,0 +1,90 @@
+"""graftlint — JAX-aware static analysis for this tree's failure modes.
+
+The hazards that kill multi-worker synchronous-SGD jobs (an axis-name
+typo deadlocking a collective, a host sync in the decode loop, a Python
+branch on a traced value recompiling every step, a rank-divergent clock
+read in collectively-executed code) are statically detectable. This
+package detects them: a dependency-free, pure-AST lint framework with a
+context-aware walker (traced regions, shard_map axis scopes, hot paths)
+and six pluggable passes. It must never import jax — the full tree lints
+in seconds on any box.
+
+Run it::
+
+    python -m k8s_distributed_deeplearning_tpu.analysis      # whole tree
+    graftlint path/to/file.py --select=collective-axis       # one pass
+
+Silence an intentional violation inline::
+
+    nxt = np.asarray(nxt)   # graftlint: disable=host-sync — honest sync
+
+``tests/test_analysis.py`` keeps the tree at zero unsuppressed findings
+(the committed baseline) and proves every pass both fires on its positive
+fixture and honors its suppressed twin.
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+
+from k8s_distributed_deeplearning_tpu.analysis.core import (  # noqa: F401
+    Finding, ModuleInfo, SEVERITY_ERROR, SEVERITY_WARNING, load_modules)
+from k8s_distributed_deeplearning_tpu.analysis.passes import (  # noqa: F401
+    PASSES, PASS_IDS, Project, fault_sites_in_tree)
+
+
+@dataclasses.dataclass(frozen=True)
+class Report:
+    """One lint run: active findings fail the gate, suppressed ones are
+    the audited, justified exceptions; parse errors are always active."""
+
+    findings: tuple[Finding, ...]
+    suppressed: tuple[Finding, ...]
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings
+
+
+def default_paths() -> list[str]:
+    """The committed-baseline scan set: the package tree itself plus the
+    examples/ directory next to the repo checkout when present (examples
+    emit telemetry events and run collectives too)."""
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    paths = [pkg]
+    examples = os.path.join(os.path.dirname(pkg), "examples")
+    if os.path.isdir(examples):
+        paths.append(examples)
+    return paths
+
+
+def run(paths: list[str] | None = None,
+        select: tuple[str, ...] | None = None) -> Report:
+    """Lint *paths* (default: the committed-baseline scan set) with the
+    selected passes (default: all). Suppression filtering happens here,
+    centrally: a finding is active unless its line carries (or sits under)
+    a matching ``# graftlint: disable=`` comment."""
+    if select:
+        unknown = set(select) - set(PASS_IDS)
+        if unknown:
+            raise ValueError(
+                f"unknown pass id(s) {sorted(unknown)} "
+                f"(known: {list(PASS_IDS)})")
+    modules, parse_errors = load_modules(paths or default_paths())
+    project = Project(modules)
+    by_path = {m.path: m for m in modules}
+    active: list[Finding] = list(parse_errors)
+    suppressed: list[Finding] = []
+    for spec in PASSES:
+        if select and spec.id not in select:
+            continue
+        for f in spec.fn(project):
+            mod = by_path.get(f.path)
+            if mod is not None and mod.suppressions.is_suppressed(
+                    f.line, f.pass_id):
+                suppressed.append(f)
+            else:
+                active.append(f)
+    key = lambda f: (f.path, f.line, f.pass_id)  # noqa: E731
+    return Report(findings=tuple(sorted(active, key=key)),
+                  suppressed=tuple(sorted(suppressed, key=key)))

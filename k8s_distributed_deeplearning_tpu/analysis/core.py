@@ -1,0 +1,649 @@
+"""graftlint core: findings, suppressions, and the context-aware AST walker.
+
+The whole package is dependency-free and pure-AST by contract: it must
+never import jax (or anything that transitively imports jax) so the full
+tree lints in well under ten seconds on a cold CPU box, in CI, with no
+accelerator runtime present. ``tests/test_analysis.py`` enforces that
+contract by AST-scanning this package's own imports.
+
+Three layers live here:
+
+- :class:`Finding` — one diagnostic (file:line, pass id, severity,
+  message, fix hint) with the stable text format the CLI and the tests
+  share.
+- :class:`Suppressions` — the inline silencing contract. A finding is
+  suppressed by ``# graftlint: disable=<pass-id>[,<pass-id>]`` on the
+  offending line or on a comment line directly above it, or file-wide by
+  ``# graftlint: disable-file=<pass-id>``. The marker comment
+  ``# graftlint: hot-path`` (above a ``def``) opts a host-side function
+  into the host-sync pass's hot-path scope.
+- :class:`ModuleInfo` / :class:`FunctionInfo` — the lexical-region model.
+  Every function in a module is classified once: is it traced (jit /
+  shard_map / pmap, by decorator, by wrap-site reference, or by lexical
+  nesting inside a traced function), which shard_map axis names are
+  statically visible around it, which of its parameters are static
+  arguments, and is it on a serving hot path. Passes then ask questions
+  against this model instead of re-deriving context.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+import tokenize
+
+SEVERITY_ERROR = "error"
+SEVERITY_WARNING = "warning"
+
+_DISABLE = re.compile(r"#\s*graftlint:\s*disable=([a-z0-9_,\- ]+)")
+_DISABLE_FILE = re.compile(r"#\s*graftlint:\s*disable-file=([a-z0-9_,\- ]+)")
+_HOT_MARK = re.compile(r"#\s*graftlint:\s*hot-path")
+
+# Dotted-name tails that mean "this wraps a traced program".
+_JIT_TAILS = frozenset({"jit", "pmap"})
+_SHARD_TAILS = frozenset({"shard_map"})
+
+# Attributes whose value is static under tracing even when the base
+# object is a tracer (shape/dtype inspection never forces a device sync
+# or a concrete value).
+STATIC_ATTRS = frozenset({"shape", "ndim", "dtype", "size", "aval",
+                          "sharding", "itemsize", "weak_type"})
+
+# Calls whose RESULT is host-concrete (the sync, if any, happened inside
+# the call — flagged separately where it matters; the result itself is
+# no longer traced).
+_UNTAINT_CALLS = frozenset({"len", "isinstance", "type", "range", "hash",
+                            "id", "float", "int", "bool", "str", "repr",
+                            "asarray", "array", "device_get", "item",
+                            "tolist", "print"})
+
+# Calls whose result is pytree STRUCTURE (treedefs, key paths, flat lists
+# in a statically-known order) — iterating or branching on it is static
+# under tracing even when the tree's leaves are traced.
+_STRUCTURAL_CALLS = frozenset({"tree_flatten", "tree_flatten_with_path",
+                               "tree_leaves_with_path", "tree_structure",
+                               "tree_paths"})
+
+# Calls that materialize a device value on the host: the call site is the
+# sync; a name REBOUND to the result is host-concrete afterwards, so
+# later float()/.item() reads of it are free.
+_MATERIALIZE_CALLS = frozenset({"asarray", "array", "device_get", "float",
+                                "int", "item", "tolist",
+                                "block_until_ready"})
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One diagnostic. ``path`` is whatever the caller scanned (kept
+    relative when the scan root was relative, so CI output is stable)."""
+
+    path: str
+    line: int
+    pass_id: str
+    severity: str
+    message: str
+    hint: str = ""
+
+    def format(self) -> str:
+        text = (f"{self.path}:{self.line}: [{self.pass_id}] "
+                f"{self.severity}: {self.message}")
+        if self.hint:
+            text += f" (hint: {self.hint})"
+        return text
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class Suppressions:
+    """Per-file suppression state parsed from comments (tokenize, so a
+    ``# graftlint:`` inside a string literal never counts)."""
+
+    def __init__(self, source: str):
+        self.by_line: dict[int, frozenset[str]] = {}
+        self.file_wide: frozenset[str] = frozenset()
+        self.hot_lines: set[int] = set()
+        self._comment_only: set[int] = set()
+        file_ids: set[str] = set()
+        try:
+            tokens = list(tokenize.generate_tokens(
+                iter(source.splitlines(True)).__next__))
+        except (tokenize.TokenError, IndentationError, SyntaxError):
+            tokens = []
+        line_has_code: set[int] = set()
+        comment_lines: set[int] = set()
+        for tok in tokens:
+            if tok.type == tokenize.COMMENT:
+                line = tok.start[0]
+                comment_lines.add(line)
+                m = _DISABLE.search(tok.string)
+                if m:
+                    ids = frozenset(p.strip() for p in m.group(1).split(",")
+                                    if p.strip())
+                    self.by_line[line] = self.by_line.get(
+                        line, frozenset()) | ids
+                m = _DISABLE_FILE.search(tok.string)
+                if m:
+                    file_ids |= {p.strip() for p in m.group(1).split(",")
+                                 if p.strip()}
+                if _HOT_MARK.search(tok.string):
+                    self.hot_lines.add(line)
+            elif tok.type not in (tokenize.NL, tokenize.NEWLINE,
+                                  tokenize.INDENT, tokenize.DEDENT,
+                                  tokenize.ENCODING, tokenize.ENDMARKER):
+                for ln in range(tok.start[0], tok.end[0] + 1):
+                    line_has_code.add(ln)
+        self.file_wide = frozenset(file_ids)
+        self._comment_only = comment_lines - line_has_code
+
+    def is_suppressed(self, line: int, pass_id: str) -> bool:
+        """Suppressed by the file-wide set, by a disable comment on the
+        line itself, or by a comment-only disable line directly above
+        (skipping further stacked comment lines)."""
+        if pass_id in self.file_wide:
+            return True
+        if pass_id in self.by_line.get(line, ()):
+            return True
+        above = line - 1
+        while above in self._comment_only:
+            if pass_id in self.by_line.get(above, ()):
+                return True
+            above -= 1
+        return False
+
+    def marks_hot(self, first_line: int) -> bool:
+        """A ``# graftlint: hot-path`` marker on a comment line directly
+        above *first_line* (the def / first decorator line)."""
+        above = first_line - 1
+        while above in self._comment_only:
+            if above in self.hot_lines:
+                return True
+            above -= 1
+        return first_line in self.hot_lines
+
+
+def dotted_name(node: ast.AST) -> str | None:
+    """'jax.lax.psum' for Attribute/Name chains; None for anything else."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def name_tail(node: ast.AST) -> str | None:
+    dn = dotted_name(node)
+    return dn.rsplit(".", 1)[-1] if dn else None
+
+
+def str_constants(node: ast.AST) -> list[str]:
+    """All string literals anywhere inside *node* (used to read axis
+    names out of shard_map/Mesh/PartitionSpec call expressions)."""
+    return [n.value for n in ast.walk(node)
+            if isinstance(n, ast.Constant) and isinstance(n.value, str)]
+
+
+def _is_partial_of(call: ast.Call, tails: frozenset[str]) -> bool:
+    if name_tail(call.func) != "partial" or not call.args:
+        return False
+    return name_tail(call.args[0]) in tails
+
+
+def _jit_like(expr: ast.expr) -> ast.Call | str | None:
+    """Classify a decorator / wrap-site expression: returns "jit" or
+    "shard_map" (plain reference, e.g. ``@jax.jit``), the Call node for
+    configured forms (``@partial(jax.jit, ...)``, ``jax.jit(f, ...)``),
+    or None."""
+    tail = name_tail(expr)
+    if tail in _JIT_TAILS:
+        return "jit"
+    if tail in _SHARD_TAILS:
+        return "shard_map"
+    if isinstance(expr, ast.Call):
+        if _is_partial_of(expr, _JIT_TAILS):
+            return expr
+        if _is_partial_of(expr, _SHARD_TAILS):
+            return expr
+        inner = name_tail(expr.func)
+        if inner in _JIT_TAILS or inner in _SHARD_TAILS:
+            return expr
+    return None
+
+
+def _static_params(call: ast.Call, params: list[str]) -> set[str]:
+    """Parameter names marked static by static_argnums/static_argnames
+    keywords on a jit-configuring call."""
+    out: set[str] = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            out |= {s for s in str_constants(kw.value)}
+        elif kw.arg == "static_argnums":
+            for n in ast.walk(kw.value):
+                if (isinstance(n, ast.Constant)
+                        and isinstance(n.value, int)
+                        and 0 <= n.value < len(params)):
+                    out.add(params[n.value])
+    return out
+
+
+class FunctionInfo:
+    """One def (or lambda) with its computed lexical context."""
+
+    def __init__(self, node: ast.AST, qualname: str,
+                 parent: "FunctionInfo | None", class_name: str | None):
+        self.node = node
+        self.name = getattr(node, "name", "<lambda>")
+        self.qualname = qualname
+        self.parent = parent
+        self.class_name = class_name
+        self.params = self._param_names(node)
+        self.static_params: set[str] = set()
+        self.jit_direct = False         # traced wrapper on THIS def
+        self.shard_mapped = False
+        self.shard_axes: frozenset[str] | None = None  # statically visible
+        self.hot_marked = False
+        self.wrap_calls: list[ast.Call] = []  # configured wrap sites
+
+    @staticmethod
+    def _param_names(node: ast.AST) -> list[str]:
+        a = node.args
+        names = [p.arg for p in
+                 (a.posonlyargs + a.args + a.kwonlyargs)]
+        if a.vararg:
+            names.append(a.vararg.arg)
+        if a.kwarg:
+            names.append(a.kwarg.arg)
+        return names
+
+    @property
+    def traced(self) -> bool:
+        """Inside a traced region: itself jit/shard_map-wrapped, or
+        lexically nested in a traced function."""
+        if self.jit_direct or self.shard_mapped:
+            return True
+        return self.parent.traced if self.parent is not None else False
+
+    def traced_root(self) -> "FunctionInfo | None":
+        """The outermost traced function enclosing (or being) this one."""
+        root = None
+        f: FunctionInfo | None = self
+        while f is not None:
+            if f.jit_direct or f.shard_mapped:
+                root = f
+            f = f.parent
+        return root
+
+    def enclosing_shard_axes(self) -> frozenset[str] | None:
+        f: FunctionInfo | None = self
+        while f is not None:
+            if f.shard_axes is not None:
+                return f.shard_axes
+            f = f.parent
+        return None
+
+    def first_line(self) -> int:
+        deco = getattr(self.node, "decorator_list", [])
+        if deco:
+            return min(d.lineno for d in deco)
+        return self.node.lineno
+
+
+class ModuleInfo:
+    """A parsed module plus its function-context index."""
+
+    def __init__(self, path: str, source: str, tree: ast.Module):
+        self.path = path
+        self.source = source
+        self.tree = tree
+        self.suppressions = Suppressions(source)
+        self.functions: list[FunctionInfo] = []
+        self.func_by_node: dict[ast.AST, FunctionInfo] = {}
+        self._index_functions()
+        self._mark_decorators()
+        self._mark_wrap_sites()
+        self._mark_hot()
+
+    # ------------------------------------------------------------ indexing
+
+    def _index_functions(self) -> None:
+        module = self
+
+        class V(ast.NodeVisitor):
+            def __init__(self):
+                self.stack: list[FunctionInfo] = []
+                self.class_stack: list[str] = []
+
+            def _add(self, node):
+                parent = self.stack[-1] if self.stack else None
+                cls = self.class_stack[-1] if self.class_stack else None
+                prefix = (parent.qualname + "." if parent
+                          else (cls + "." if cls else ""))
+                name = getattr(node, "name", "<lambda>")
+                fi = FunctionInfo(node, prefix + name, parent, cls)
+                module.functions.append(fi)
+                module.func_by_node[node] = fi
+                self.stack.append(fi)
+                self.generic_visit(node)
+                self.stack.pop()
+
+            def visit_FunctionDef(self, node):
+                self._add(node)
+
+            def visit_AsyncFunctionDef(self, node):
+                self._add(node)
+
+            def visit_Lambda(self, node):
+                self._add(node)
+
+            def visit_ClassDef(self, node):
+                self.class_stack.append(node.name)
+                self.generic_visit(node)
+                self.class_stack.pop()
+
+        V().visit(self.tree)
+
+    def _mark_decorators(self) -> None:
+        for fi in self.functions:
+            for deco in getattr(fi.node, "decorator_list", []):
+                kind = _jit_like(deco)
+                if kind is None:
+                    continue
+                if kind == "shard_map" or (
+                        isinstance(kind, ast.Call)
+                        and _is_partial_of(kind, _SHARD_TAILS)):
+                    fi.shard_mapped = True
+                    if isinstance(kind, ast.Call):
+                        fi.wrap_calls.append(kind)
+                        axes = _shard_axes_of(kind)
+                        if axes:
+                            fi.shard_axes = axes
+                else:
+                    fi.jit_direct = True
+                    if isinstance(kind, ast.Call):
+                        fi.wrap_calls.append(kind)
+                        fi.static_params |= _static_params(kind, fi.params)
+
+    def _mark_wrap_sites(self) -> None:
+        """jax.jit(f, ...) / shard_map(f, mesh=..., ...) where f names a
+        local def (directly, or through functools.partial(f, ...))."""
+        by_name: dict[str, list[FunctionInfo]] = {}
+        for fi in self.functions:
+            by_name.setdefault(fi.name, []).append(fi)
+        for call in ast.walk(self.tree):
+            if not isinstance(call, ast.Call) or not call.args:
+                continue
+            tail = name_tail(call.func)
+            if tail not in _JIT_TAILS and tail not in _SHARD_TAILS:
+                continue
+            target = call.args[0]
+            if (isinstance(target, ast.Call)
+                    and name_tail(target.func) == "partial"
+                    and target.args):
+                target = target.args[0]
+            tname = name_tail(target)
+            if tname is None:
+                continue
+            for fi in by_name.get(tname, []):
+                if tail in _SHARD_TAILS:
+                    fi.shard_mapped = True
+                    axes = _shard_axes_of(call)
+                    if axes and fi.shard_axes is None:
+                        fi.shard_axes = axes
+                else:
+                    fi.jit_direct = True
+                    fi.static_params |= _static_params(call, fi.params)
+                fi.wrap_calls.append(call)
+
+    def _mark_hot(self) -> None:
+        for fi in self.functions:
+            if isinstance(fi.node, ast.Lambda):
+                continue
+            if self.suppressions.marks_hot(fi.first_line()):
+                fi.hot_marked = True
+            # The serving decode loop by convention: <Something>Engine.step
+            if (fi.class_name and "Engine" in fi.class_name
+                    and fi.name == "step"):
+                fi.hot_marked = True
+
+    # ------------------------------------------------------------- queries
+
+    def enclosing_function(self, node: ast.AST,
+                           parents: dict[ast.AST, ast.AST]
+                           ) -> FunctionInfo | None:
+        cur = parents.get(node)
+        while cur is not None:
+            fi = self.func_by_node.get(cur)
+            if fi is not None:
+                return fi
+            cur = parents.get(cur)
+        return None
+
+    def parent_map(self) -> dict[ast.AST, ast.AST]:
+        parents: dict[ast.AST, ast.AST] = {}
+        for parent in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(parent):
+                parents[child] = parent
+        return parents
+
+
+def _shard_axes_of(call: ast.Call) -> frozenset[str] | None:
+    """Axis names statically visible on a shard_map call: string literals
+    inside its mesh=/in_specs=/out_specs=/axis_names= keywords. None when
+    nothing is literal (axes flow in as variables — can't check)."""
+    axes: set[str] = set()
+    for kw in call.keywords:
+        if kw.arg in ("mesh", "in_specs", "out_specs", "axis_names"):
+            axes |= set(str_constants(kw.value))
+    return frozenset(axes) or None
+
+
+# --------------------------------------------------------------- taint
+
+class Taint:
+    """Flow-insensitive traced-value tracking inside one function body.
+
+    Roots are the function's non-static parameters (traced operands) or,
+    for host-side hot-path functions, the results of calls into traced
+    programs. Two passes over the body approximate a fixpoint; attribute
+    reads in STATIC_ATTRS and host-concretizing calls break the chain.
+    """
+
+    def __init__(self, func: FunctionInfo,
+                 call_seed: "set[str] | None" = None):
+        self.func = func
+        self.call_seed = call_seed   # callee names whose results are traced
+        self.tainted: set[str] = set()
+        self.materialized: set[str] = set()  # rebound to a host sync result
+        if call_seed is None:
+            self.tainted |= (set(func.params) - func.static_params)
+        body = getattr(func.node, "body", None)
+        if body is None:
+            return
+        stmts = body if isinstance(body, list) else [body]
+        for _ in range(2):
+            for st in stmts:
+                self._stmt(st)
+
+    # -- statements (only assignment-shaped ones move taint) --------------
+
+    def _stmt(self, st: ast.stmt) -> None:
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.ClassDef)):
+            return          # nested defs get their own analysis
+        if isinstance(st, ast.Assign):
+            if (isinstance(st.value, ast.Call)
+                    and name_tail(st.value.func) in _MATERIALIZE_CALLS):
+                for t in st.targets:
+                    self._materialize_target(t)
+            if self.expr(st.value):
+                for t in st.targets:
+                    self._taint_target(t)
+        elif isinstance(st, ast.AnnAssign) and st.value is not None:
+            if self.expr(st.value):
+                self._taint_target(st.target)
+        elif isinstance(st, ast.AugAssign):
+            if self.expr(st.value) or self.expr(st.target):
+                self._taint_target(st.target)
+        elif isinstance(st, (ast.For, ast.AsyncFor)):
+            if self.expr(st.iter):
+                self._taint_target(st.target)
+            for s in st.body + st.orelse:
+                self._stmt(s)
+        elif isinstance(st, ast.While):
+            for s in st.body + st.orelse:
+                self._stmt(s)
+        elif isinstance(st, ast.If):
+            for s in st.body + st.orelse:
+                self._stmt(s)
+        elif isinstance(st, (ast.With, ast.AsyncWith)):
+            for s in st.body:
+                self._stmt(s)
+        elif isinstance(st, ast.Try):
+            for s in (st.body + st.orelse + st.finalbody
+                      + [h for hd in st.handlers for h in hd.body]):
+                self._stmt(s)
+
+    def _taint_target(self, target: ast.expr) -> None:
+        if isinstance(target, ast.Name):
+            self.tainted.add(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for el in target.elts:
+                self._taint_target(el)
+        elif isinstance(target, ast.Starred):
+            self._taint_target(target.value)
+        # Attribute/Subscript targets (self._x = ...) aren't tracked.
+
+    def _materialize_target(self, target: ast.expr) -> None:
+        if isinstance(target, ast.Name):
+            self.materialized.add(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for el in target.elts:
+                self._materialize_target(el)
+
+    # -- expressions -------------------------------------------------------
+
+    def expr(self, e: ast.expr | None) -> bool:
+        if e is None or isinstance(e, ast.Constant):
+            return False
+        if isinstance(e, ast.Name):
+            return e.id in self.tainted
+        if isinstance(e, ast.Attribute):
+            if e.attr in STATIC_ATTRS:
+                return False
+            return self.expr(e.value)
+        if isinstance(e, ast.Call):
+            return self._call(e)
+        if isinstance(e, (ast.Tuple, ast.List, ast.Set)):
+            return any(self.expr(el) for el in e.elts)
+        if isinstance(e, ast.Dict):
+            return any(self.expr(k) for k in e.keys if k is not None) or \
+                any(self.expr(v) for v in e.values)
+        if isinstance(e, ast.BoolOp):
+            return any(self.expr(v) for v in e.values)
+        if isinstance(e, ast.BinOp):
+            return self.expr(e.left) or self.expr(e.right)
+        if isinstance(e, ast.UnaryOp):
+            return self.expr(e.operand)
+        if isinstance(e, ast.Compare):
+            # None-ness is pytree structure: `x is None` specializes the
+            # trace once per structure, it never reads the value.
+            if (all(isinstance(op, (ast.Is, ast.IsNot)) for op in e.ops)
+                    and all(isinstance(c, ast.Constant) and c.value is None
+                            for c in e.comparators)):
+                return False
+            return self.expr(e.left) or any(self.expr(c)
+                                            for c in e.comparators)
+        if isinstance(e, ast.Subscript):
+            return self.expr(e.value)
+        if isinstance(e, ast.Starred):
+            return self.expr(e.value)
+        if isinstance(e, ast.IfExp):
+            return (self.expr(e.body) or self.expr(e.test)
+                    or self.expr(e.orelse))
+        if isinstance(e, ast.JoinedStr):
+            return any(self.expr(v.value) for v in e.values
+                       if isinstance(v, ast.FormattedValue))
+        if isinstance(e, (ast.ListComp, ast.SetComp, ast.GeneratorExp,
+                          ast.DictComp)):
+            for gen in e.generators:
+                if self.expr(gen.iter):
+                    self._taint_target(gen.target)
+            if isinstance(e, ast.DictComp):
+                return self.expr(e.key) or self.expr(e.value)
+            return self.expr(e.elt)
+        if isinstance(e, ast.Await):
+            return self.expr(e.value)
+        if isinstance(e, ast.NamedExpr):
+            if self.expr(e.value):
+                self._taint_target(e.target)
+                return True
+            return False
+        return False
+
+    def _call(self, e: ast.Call) -> bool:
+        tail = name_tail(e.func)
+        if self.call_seed is not None:
+            # Hot-path mode: taint originates from calls into traced
+            # programs (or calls through callable parameters, which in a
+            # hot loop are the step functions).
+            seeded = tail in self.call_seed
+            if (isinstance(e.func, ast.Name)
+                    and e.func.id in self.func.params):
+                seeded = True
+            if seeded:
+                return True
+        if tail in _UNTAINT_CALLS or tail in _STRUCTURAL_CALLS:
+            return False
+        if isinstance(e.func, ast.Attribute):
+            if e.func.attr in ("item", "tolist", "block_until_ready"):
+                return False
+        return (self.expr(e.func)
+                or any(self.expr(a) for a in e.args)
+                or any(self.expr(kw.value) for kw in e.keywords))
+
+
+# --------------------------------------------------------------- loading
+
+DEFAULT_EXCLUDE_DIRS = frozenset({"__pycache__", ".git", ".venv", "node_modules",
+                                  "fixtures"})
+
+
+def iter_py_files(paths: list[str]) -> list[str]:
+    out: list[str] = []
+    for p in paths:
+        if os.path.isfile(p):
+            out.append(p)
+            continue
+        for dirpath, dirnames, names in os.walk(p):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d not in DEFAULT_EXCLUDE_DIRS)
+            for n in sorted(names):
+                if n.endswith(".py"):
+                    out.append(os.path.join(dirpath, n))
+    return out
+
+
+def load_modules(paths: list[str]) -> tuple[list[ModuleInfo], list[Finding]]:
+    """Parse every .py under *paths*. Unparseable files become findings
+    (pass id "parse") rather than crashes — a linter that dies on the
+    tree it guards is worse than useless."""
+    modules: list[ModuleInfo] = []
+    errors: list[Finding] = []
+    for path in iter_py_files(paths):
+        try:
+            with open(path, encoding="utf-8") as f:
+                source = f.read()
+            tree = ast.parse(source, filename=path)
+        except (SyntaxError, UnicodeDecodeError, OSError) as e:
+            line = getattr(e, "lineno", 1) or 1
+            errors.append(Finding(path=path, line=line, pass_id="parse",
+                                  severity=SEVERITY_ERROR,
+                                  message=f"cannot parse: {e}",
+                                  hint="fix the syntax error"))
+            continue
+        modules.append(ModuleInfo(path, source, tree))
+    return modules, errors

@@ -1,0 +1,716 @@
+"""The graftlint passes: six hazard classes, one walker, zero imports of jax.
+
+Every pass is a function ``(Project) -> list[Finding]`` registered in
+:data:`PASSES`. A pass reports everything it sees — suppression filtering
+happens once, centrally, in :func:`analysis.run` — so ``--show-suppressed``
+and the fixture tests can observe raw findings.
+
+Adding a pass: write the function, append a :class:`PassSpec`, add a
+positive + suppressed fixture pair under ``tests/fixtures/graftlint/``
+(the test matrix in ``tests/test_analysis.py`` picks both up by naming
+convention), and document the hazard in README "Static analysis".
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Callable
+
+from k8s_distributed_deeplearning_tpu.analysis.core import (
+    Finding, ModuleInfo, SEVERITY_ERROR, SEVERITY_WARNING, Taint,
+    dotted_name, load_modules, name_tail, str_constants)
+
+# ----------------------------------------------------------------- project
+
+
+class Project:
+    """The scanned module set plus lazily-built shared indices."""
+
+    def __init__(self, modules: list[ModuleInfo]):
+        self.modules = modules
+        self._parents: dict[str, dict[ast.AST, ast.AST]] = {}
+
+    def parents(self, mod: ModuleInfo) -> dict[ast.AST, ast.AST]:
+        pm = self._parents.get(mod.path)
+        if pm is None:
+            pm = self._parents[mod.path] = mod.parent_map()
+        return pm
+
+
+@dataclasses.dataclass(frozen=True)
+class PassSpec:
+    id: str
+    doc: str
+    fn: Callable[[Project], list[Finding]]
+
+
+# --------------------------------------------------------- shared helpers
+
+_COLLECTIVES_AXIS1 = frozenset({"psum", "pmean", "pmax", "pmin", "ppermute",
+                                "all_gather", "all_to_all", "psum_scatter",
+                                "pshuffle"})
+_COLLECTIVES_AXIS0 = frozenset({"axis_index", "axis_size"})
+_COLLECTIVE_TAILS = _COLLECTIVES_AXIS1 | _COLLECTIVES_AXIS0
+
+_SNAKE = re.compile(r"^[a-z][a-z0-9_]*$")
+
+
+def _walk_skip_nested(node: ast.AST):
+    """Yield nodes of *node*'s body without descending into nested
+    function/class definitions (their params are separate taint scopes)."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        n = stack.pop()
+        yield n
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda, ast.ClassDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def _base_name(e: ast.expr) -> str | None:
+    """The root Name under Subscript/Attribute chains (``nxt[slot]`` ->
+    ``nxt``), for checking against a taint's materialized set."""
+    while isinstance(e, (ast.Subscript, ast.Attribute)):
+        e = e.value
+    return e.id if isinstance(e, ast.Name) else None
+
+
+def _is_np_call(call: ast.Call, attrs: frozenset[str]) -> bool:
+    dn = dotted_name(call.func)
+    if not dn or "." not in dn:
+        return False
+    head, _, tail = dn.rpartition(".")
+    return tail in attrs and head.split(".")[0] in ("np", "numpy", "onp")
+
+
+def _collective_axis_args(call: ast.Call) -> ast.expr | None:
+    tail = name_tail(call.func)
+    for kw in call.keywords:
+        if kw.arg == "axis_name":
+            return kw.value
+    idx = 0 if tail in _COLLECTIVES_AXIS0 else 1
+    if len(call.args) > idx:
+        return call.args[idx]
+    return None
+
+
+def _literal_axis_names(expr: ast.expr) -> list[str]:
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+        return [expr.value]
+    if isinstance(expr, (ast.Tuple, ast.List)):
+        out = []
+        for el in expr.elts:
+            if isinstance(el, ast.Constant) and isinstance(el.value, str):
+                out.append(el.value)
+            else:
+                return []    # partially dynamic — don't guess
+        return out
+    return []
+
+
+# ------------------------------------------------------- pass 1: recompile
+
+def pass_recompile(project: Project) -> list[Finding]:
+    """Python-level decisions on traced values inside jit/shard_map
+    regions: branches, iteration, ``float()``/``int()``/``bool()``/
+    ``.item()`` concretization, f-string formatting — each either fails at
+    trace time or forces a silent recompile per distinct value. Also flags
+    ``jax.jit`` wrappers constructed inside loops (a fresh wrapper means a
+    fresh compile cache: every call recompiles)."""
+    findings: list[Finding] = []
+    for mod in project.modules:
+        for fi in mod.functions:
+            if not (fi.jit_direct or fi.shard_mapped):
+                continue
+            taint = Taint(fi)
+            for n in _walk_skip_nested(fi.node):
+                if isinstance(n, (ast.If, ast.While)) and taint.expr(n.test):
+                    findings.append(Finding(
+                        mod.path, n.lineno, "recompile", SEVERITY_ERROR,
+                        f"Python branch on a traced value inside "
+                        f"{fi.qualname!r}",
+                        "use jnp.where/lax.cond, or mark the operand "
+                        "static_argnames"))
+                elif isinstance(n, ast.For) and taint.expr(n.iter):
+                    findings.append(Finding(
+                        mod.path, n.lineno, "recompile", SEVERITY_ERROR,
+                        f"Python iteration over a traced value inside "
+                        f"{fi.qualname!r}",
+                        "use lax.scan/fori_loop over traced data"))
+                elif isinstance(n, ast.Call):
+                    tail = name_tail(n.func)
+                    if (tail in ("float", "int", "bool")
+                            and isinstance(n.func, ast.Name)
+                            and any(taint.expr(a) for a in n.args)):
+                        findings.append(Finding(
+                            mod.path, n.lineno, "recompile", SEVERITY_ERROR,
+                            f"{tail}() concretizes a traced value inside "
+                            f"{fi.qualname!r}",
+                            "keep the value on-device (jnp ops) or make it "
+                            "a static argument"))
+                    elif (isinstance(n.func, ast.Attribute)
+                          and n.func.attr in ("item", "tolist")
+                          and taint.expr(n.func.value)):
+                        findings.append(Finding(
+                            mod.path, n.lineno, "recompile", SEVERITY_ERROR,
+                            f".{n.func.attr}() concretizes a traced value "
+                            f"inside {fi.qualname!r}",
+                            "move the host read outside the traced region"))
+                elif isinstance(n, ast.JoinedStr) and taint.expr(n):
+                    findings.append(Finding(
+                        mod.path, n.lineno, "recompile", SEVERITY_ERROR,
+                        f"f-string formats a traced value inside "
+                        f"{fi.qualname!r}",
+                        "format after the program returns (or use "
+                        "jax.debug.print)"))
+        findings.extend(_jit_in_loop(project, mod))
+    return findings
+
+
+def _jit_in_loop(project: Project, mod: ModuleInfo) -> list[Finding]:
+    out: list[Finding] = []
+    parents = project.parents(mod)
+    for n in ast.walk(mod.tree):
+        if not isinstance(n, ast.Call):
+            continue
+        tail = name_tail(n.func)
+        if tail not in ("jit", "pmap"):
+            continue
+        # Memoized construction (result stored under a subscript key —
+        # the compile-once-per-shape cache idiom) is the fix, not the bug.
+        memoized = False
+        hop: ast.AST | None = n
+        while hop is not None and not isinstance(
+                hop, (ast.For, ast.While, ast.FunctionDef,
+                      ast.AsyncFunctionDef, ast.Module)):
+            if isinstance(hop, ast.Assign) and any(
+                    isinstance(t, ast.Subscript) for t in hop.targets):
+                memoized = True
+            hop = parents.get(hop)
+        if memoized:
+            continue
+        anc = parents.get(n)
+        while anc is not None:
+            if isinstance(anc, (ast.For, ast.While)):
+                out.append(Finding(
+                    mod.path, n.lineno, "recompile", SEVERITY_ERROR,
+                    f"jax.{tail} wrapper constructed inside a loop",
+                    "hoist the wrapper out of the loop — each fresh "
+                    "wrapper has an empty compile cache"))
+                break
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                break   # per-call jit in a helper is the factory idiom
+            anc = parents.get(anc)
+    return out
+
+
+# -------------------------------------------------- pass 2: collective-axis
+
+def _axis_universe(project: Project) -> set[str]:
+    """Every axis name the tree DECLARES: Mesh axis tuples, ``axis_names``
+    accessors, shard_map/pmap specs, PartitionSpec literals, and
+    ``axis_name=...`` parameter defaults. Collective call sites are
+    deliberately NOT part of the universe — a typo there must not
+    self-validate."""
+    axes: set[str] = set()
+    for mod in project.modules:
+        for n in ast.walk(mod.tree):
+            if isinstance(n, ast.Call):
+                tail = name_tail(n.func)
+                if tail == "Mesh":
+                    for a in n.args[1:]:
+                        axes.update(str_constants(a))
+                    for kw in n.keywords:
+                        if kw.arg == "axis_names":
+                            axes.update(str_constants(kw.value))
+                elif tail in ("P", "PartitionSpec", "NamedSharding"):
+                    axes.update(str_constants(n))
+                elif tail in ("shard_map", "pmap"):
+                    for kw in n.keywords:
+                        if kw.arg in ("mesh", "in_specs", "out_specs",
+                                      "axis_names", "axis_name"):
+                            axes.update(str_constants(kw.value))
+            elif isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if n.name == "axis_names":
+                    for r in ast.walk(n):
+                        if isinstance(r, ast.Return) and r.value is not None:
+                            axes.update(str_constants(r.value))
+                a = n.args
+                params = a.posonlyargs + a.args + a.kwonlyargs
+                defaults = ([None] * (len(a.posonlyargs + a.args)
+                                      - len(a.defaults))
+                            + list(a.defaults) + list(a.kw_defaults))
+                for p, d in zip(params, defaults):
+                    if (p.arg.startswith("axis_name") and d is not None):
+                        axes.update(str_constants(d))
+    return axes
+
+
+def pass_collective_axis(project: Project) -> list[Finding]:
+    """Literal axis names at collective call sites must exist: against the
+    statically-visible axes of the enclosing ``shard_map`` when there is
+    one, else against the tree-wide declared axis universe. A mismatched
+    name is the deadlock class — one rank enters a collective the others
+    never reach."""
+    universe = _axis_universe(project)
+    findings: list[Finding] = []
+    for mod in project.modules:
+        parents = project.parents(mod)
+        for n in ast.walk(mod.tree):
+            if not isinstance(n, ast.Call):
+                continue
+            if name_tail(n.func) not in _COLLECTIVE_TAILS:
+                continue
+            axis_arg = _collective_axis_args(n)
+            if axis_arg is None:
+                continue
+            names = _literal_axis_names(axis_arg)
+            if not names:
+                continue    # variable axis — checked at the declaring site
+            fi = mod.enclosing_function(n, parents)
+            enclosing = fi.enclosing_shard_axes() if fi else None
+            for name in names:
+                if enclosing is not None:
+                    if name not in enclosing:
+                        findings.append(Finding(
+                            mod.path, n.lineno, "collective-axis",
+                            SEVERITY_ERROR,
+                            f"axis {name!r} is not among the enclosing "
+                            f"shard_map's axes {sorted(enclosing)}",
+                            "fix the axis name — mismatched collective "
+                            "axes deadlock the mesh"))
+                elif name not in universe:
+                    findings.append(Finding(
+                        mod.path, n.lineno, "collective-axis",
+                        SEVERITY_ERROR,
+                        f"axis {name!r} is not declared by any Mesh/"
+                        "axis_names/PartitionSpec in the scanned tree",
+                        "likely a typo'd axis name; declare it on a mesh "
+                        "or fix the literal"))
+    return findings
+
+
+# ----------------------------------------------------- pass 3: host-sync
+
+def pass_host_sync(project: Project) -> list[Finding]:
+    """Host synchronization where it stalls the device pipeline: inside
+    traced regions (``block_until_ready``/``device_get``/``np.asarray`` on
+    traced values — these force a round-trip at trace or run time), and on
+    serving/training hot paths (``*Engine.step`` and functions marked
+    ``# graftlint: hot-path``), where any host materialization of a value
+    produced by a compiled program blocks the decode/step loop."""
+    findings: list[Finding] = []
+    for mod in project.modules:
+        traced_names = {f.name for f in mod.functions
+                        if f.jit_direct or f.shard_mapped}
+        for fi in mod.functions:
+            if fi.jit_direct or fi.shard_mapped:
+                taint = Taint(fi)
+                for n in _walk_skip_nested(fi.node):
+                    if not isinstance(n, ast.Call):
+                        continue
+                    if (isinstance(n.func, ast.Attribute)
+                            and n.func.attr == "block_until_ready"):
+                        findings.append(Finding(
+                            mod.path, n.lineno, "host-sync", SEVERITY_ERROR,
+                            f"block_until_ready inside traced "
+                            f"{fi.qualname!r}",
+                            "syncing inside a traced region defeats async "
+                            "dispatch — sync outside the program"))
+                    elif name_tail(n.func) in ("block_until_ready",
+                                               "device_get"):
+                        findings.append(Finding(
+                            mod.path, n.lineno, "host-sync", SEVERITY_ERROR,
+                            f"jax.{name_tail(n.func)} inside traced "
+                            f"{fi.qualname!r}",
+                            "device->host transfer does not belong in a "
+                            "traced region"))
+                    elif (_is_np_call(n, frozenset({"asarray", "array"}))
+                          and any(taint.expr(a) for a in n.args)):
+                        findings.append(Finding(
+                            mod.path, n.lineno, "host-sync", SEVERITY_ERROR,
+                            f"numpy materialization of a traced value "
+                            f"inside {fi.qualname!r}",
+                            "use jnp — np.asarray on a tracer forces "
+                            "concretization"))
+            elif fi.hot_marked:
+                taint = Taint(fi, call_seed=traced_names)
+                for n in _walk_skip_nested(fi.node):
+                    if not isinstance(n, ast.Call):
+                        continue
+                    is_sync = False
+                    what = None
+                    if (_is_np_call(n, frozenset({"asarray", "array"}))
+                            and any(taint.expr(a) for a in n.args)):
+                        is_sync, what = True, "numpy materialization"
+                    elif (name_tail(n.func) in ("float", "int")
+                          and isinstance(n.func, ast.Name)
+                          and any(taint.expr(a)
+                                  and _base_name(a) not in taint.materialized
+                                  for a in n.args)):
+                        is_sync, what = True, f"{name_tail(n.func)}()"
+                    elif (isinstance(n.func, ast.Attribute)
+                          and n.func.attr in ("item", "tolist",
+                                              "block_until_ready")
+                          and taint.expr(n.func.value)
+                          and (_base_name(n.func.value)
+                               not in taint.materialized)):
+                        is_sync, what = True, f".{n.func.attr}()"
+                    elif (name_tail(n.func) == "device_get"
+                          and any(taint.expr(a) for a in n.args)):
+                        is_sync, what = True, "jax.device_get"
+                    if is_sync:
+                        findings.append(Finding(
+                            mod.path, n.lineno, "host-sync", SEVERITY_ERROR,
+                            f"{what} blocks the hot path in "
+                            f"{fi.qualname!r} on a device value",
+                            "batch the sync per iteration (one honest "
+                            "sync) or move it off the hot path; suppress "
+                            "with a justification if intentional"))
+    return findings
+
+
+# --------------------------------------------- pass 4: rank-divergence
+
+_WALLCLOCK = frozenset({"time.time", "time.monotonic", "time.perf_counter",
+                        "time.time_ns", "time.monotonic_ns",
+                        "time.perf_counter_ns"})
+
+
+def _collective_scope(mod: ModuleInfo) -> set[ast.AST]:
+    """Function nodes whose bodies run collectively: shard_map-wrapped,
+    axis_name-parameterized, or traced with a collective call inside —
+    plus everything lexically nested in one of those."""
+    roots: set[ast.AST] = set()
+    for fi in mod.functions:
+        if fi.shard_mapped:
+            roots.add(fi.node)
+            continue
+        if any(p.startswith("axis_name") for p in fi.params):
+            roots.add(fi.node)
+            continue
+        if fi.traced:
+            for n in _walk_skip_nested(fi.node):
+                if (isinstance(n, ast.Call)
+                        and name_tail(n.func) in _COLLECTIVE_TAILS):
+                    roots.add(fi.node)
+                    break
+    scope: set[ast.AST] = set()
+    for fi in mod.functions:
+        f = fi
+        while f is not None:
+            if f.node in roots:
+                scope.add(fi.node)
+                break
+            f = f.parent
+    return scope
+
+
+def pass_rank_divergence(project: Project) -> list[Finding]:
+    """Rank-divergent inputs feeding collectively-executed code:
+    wall-clock reads, process-local RNG, environment reads, and
+    hash-seed-dependent set iteration. When ranks trace or branch
+    differently, the SPMD programs diverge and the next collective
+    deadlocks."""
+    findings: list[Finding] = []
+    for mod in project.modules:
+        scope = _collective_scope(mod)
+        for fnode in scope:
+            fi = mod.func_by_node[fnode]
+            for n in _walk_skip_nested(fnode):
+                if isinstance(n, ast.Call):
+                    dn = dotted_name(n.func) or ""
+                    if dn in _WALLCLOCK:
+                        findings.append(Finding(
+                            mod.path, n.lineno, "rank-divergence",
+                            SEVERITY_ERROR,
+                            f"wall-clock read ({dn}) inside collectively-"
+                            f"executed {fi.qualname!r}",
+                            "clocks differ across ranks — time outside "
+                            "the collective region, or broadcast rank 0's"))
+                    elif (dn.startswith("random.")
+                          or dn.startswith("np.random.")
+                          or dn.startswith("numpy.random.")
+                          or dn in ("os.urandom", "uuid.uuid4")):
+                        findings.append(Finding(
+                            mod.path, n.lineno, "rank-divergence",
+                            SEVERITY_ERROR,
+                            f"process-local RNG ({dn}) inside collectively-"
+                            f"executed {fi.qualname!r}",
+                            "use jax.random with a key derived from the "
+                            "shared seed (fold_in rank/step)"))
+                    elif dn == "os.getenv":
+                        findings.append(Finding(
+                            mod.path, n.lineno, "rank-divergence",
+                            SEVERITY_ERROR,
+                            f"environment read inside collectively-"
+                            f"executed {fi.qualname!r}",
+                            "env vars can differ per pod — resolve before "
+                            "entering collective code"))
+                elif (isinstance(n, ast.Attribute) and n.attr == "environ"
+                      and dotted_name(n) == "os.environ"):
+                    findings.append(Finding(
+                        mod.path, n.lineno, "rank-divergence",
+                        SEVERITY_ERROR,
+                        f"os.environ read inside collectively-executed "
+                        f"{fi.qualname!r}",
+                        "env vars can differ per pod — resolve before "
+                        "entering collective code"))
+                elif isinstance(n, ast.For):
+                    it = n.iter
+                    if (isinstance(it, ast.Call)
+                            and name_tail(it.func) in ("set", "frozenset")
+                            ) or isinstance(it, ast.Set):
+                        findings.append(Finding(
+                            mod.path, n.lineno, "rank-divergence",
+                            SEVERITY_ERROR,
+                            f"iteration over a set inside collectively-"
+                            f"executed {fi.qualname!r}",
+                            "set order depends on PYTHONHASHSEED and can "
+                            "differ across ranks — sorted(...) it"))
+    return findings
+
+
+# ---------------------------------------------- pass 5: event-registry
+
+def _find_events_registry(project: Project
+                          ) -> tuple[dict[str, tuple[str, int]], str | None]:
+    registry: dict[str, tuple[str, int]] = {}
+    reg_path = None
+    for mod in project.modules:
+        for n in ast.walk(mod.tree):
+            target = None
+            if isinstance(n, ast.Assign) and len(n.targets) == 1:
+                target = n.targets[0]
+            elif isinstance(n, ast.AnnAssign):
+                target = n.target
+            if (target is None or not isinstance(target, ast.Name)
+                    or target.id != "EVENTS"):
+                continue
+            value = n.value
+            if not isinstance(value, ast.Dict):
+                continue
+            reg_path = mod.path
+            for k in value.keys:
+                if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                    registry[k.value] = (mod.path, k.lineno)
+    return registry, reg_path
+
+
+def _emit_sites(project: Project) -> list[tuple[str, str, int]]:
+    """(event-name, path, line) for every ``<x>.emit("name", ...)`` call
+    with a statically-known name."""
+    sites = []
+    for mod in project.modules:
+        for n in ast.walk(mod.tree):
+            if (not isinstance(n, ast.Call)
+                    or not isinstance(n.func, ast.Attribute)
+                    or n.func.attr != "emit" or not n.args):
+                continue
+            arg = n.args[0]
+            name = None
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                name = arg.value
+            elif isinstance(arg, ast.JoinedStr) and all(
+                    isinstance(v, ast.Constant) for v in arg.values):
+                name = "".join(v.value for v in arg.values)
+            if name is not None:
+                sites.append((name, mod.path, n.lineno))
+    return sites
+
+
+def pass_event_registry(project: Project) -> list[Finding]:
+    """The JSONL event-name contract (telemetry/events.py), both
+    directions: every statically-named ``.emit()`` site must use a
+    registered snake_case event (Grafana/Loki select on these literals —
+    an unregistered name silently breaks panels), and every registered
+    event must have an emit site (a dead name means a renamed site left
+    the dashboards selecting on nothing). Subsumes the old golden test in
+    tests/test_events_schema.py."""
+    registry, reg_path = _find_events_registry(project)
+    if reg_path is None:
+        return []    # nothing to check against in this scan set
+    findings: list[Finding] = []
+    seen: set[str] = set()
+    for name, path, line in _emit_sites(project):
+        seen.add(name)
+        if name not in registry:
+            findings.append(Finding(
+                path, line, "event-registry", SEVERITY_ERROR,
+                f"event {name!r} is not registered in the EVENTS "
+                "registry",
+                "add it to telemetry/events.py (and update dashboards/"
+                "queries) in the same PR"))
+        if not _SNAKE.match(name):
+            findings.append(Finding(
+                path, line, "event-registry", SEVERITY_ERROR,
+                f"event name {name!r} is not snake_case",
+                "event names are Loki label values — keep them "
+                "snake_case"))
+    for name, (path, line) in registry.items():
+        if not _SNAKE.match(name):
+            findings.append(Finding(
+                path, line, "event-registry", SEVERITY_ERROR,
+                f"registered event {name!r} is not snake_case",
+                "rename the registry entry and its emit sites"))
+        if name not in seen:
+            findings.append(Finding(
+                path, line, "event-registry", SEVERITY_ERROR,
+                f"registered event {name!r} has no .emit() site in the "
+                "scanned tree",
+                "remove the dead entry, or suppress if the event is "
+                "written by another plane"))
+    return findings
+
+
+# ------------------------------------------------ pass 6: fault-site
+
+def _find_fault_registry(project: Project
+                         ) -> tuple[dict[str, tuple[str, int]],
+                                    dict[str, tuple[str, int]], str | None]:
+    sites: dict[str, tuple[str, int]] = {}
+    table: dict[str, tuple[str, int]] = {}
+    reg_path = None
+    for mod in project.modules:
+        mod_sites: dict[str, tuple[str, int]] = {}
+        mod_table: dict[str, tuple[str, int]] = {}
+        for n in ast.walk(mod.tree):
+            if not isinstance(n, ast.Assign) or len(n.targets) != 1:
+                continue
+            t = n.targets[0]
+            if not isinstance(t, ast.Name):
+                continue
+            if t.id == "SITES" and isinstance(n.value, (ast.Tuple, ast.List)):
+                for el in n.value.elts:
+                    if (isinstance(el, ast.Constant)
+                            and isinstance(el.value, str)):
+                        mod_sites[el.value] = (mod.path, el.lineno)
+            elif t.id == "_SITE_ACTIONS" and isinstance(n.value, ast.Dict):
+                for k in n.value.keys:
+                    if (isinstance(k, ast.Constant)
+                            and isinstance(k.value, str)):
+                        mod_table[k.value] = (mod.path, k.lineno)
+        if mod_sites and mod_table:
+            sites, table, reg_path = mod_sites, mod_table, mod.path
+    return sites, table, reg_path
+
+
+def fault_site_usages(modules: list[ModuleInfo],
+                      exclude_path: str | None = None
+                      ) -> dict[str, list[tuple[str, int]]]:
+    """Site names referenced by hook code: ``.fire("site", ...)`` /
+    ``.suppressed("site", ...)`` calls and ``<x>.site == "site"``
+    comparisons (the executor's out-of-process hook shape)."""
+    used: dict[str, list[tuple[str, int]]] = {}
+
+    def add(name, path, line):
+        used.setdefault(name, []).append((path, line))
+
+    for mod in modules:
+        if exclude_path is not None and mod.path == exclude_path:
+            continue
+        for n in ast.walk(mod.tree):
+            if (isinstance(n, ast.Call)
+                    and isinstance(n.func, ast.Attribute)
+                    and n.func.attr in ("fire", "suppressed") and n.args):
+                a = n.args[0]
+                if isinstance(a, ast.Constant) and isinstance(a.value, str):
+                    add(a.value, mod.path, n.lineno)
+            elif isinstance(n, ast.Compare) and len(n.comparators) == 1:
+                sides = (n.left, n.comparators[0])
+                if not isinstance(n.ops[0], (ast.Eq, ast.NotEq)):
+                    continue
+                attr = [s for s in sides if isinstance(s, ast.Attribute)
+                        and s.attr == "site"]
+                lit = [s for s in sides if isinstance(s, ast.Constant)
+                       and isinstance(s.value, str)]
+                if attr and lit:
+                    add(lit[0].value, mod.path, n.lineno)
+    return used
+
+
+def pass_fault_site(project: Project) -> list[Finding]:
+    """The fault-injection hook contract (faults/plan.py), both
+    directions: every site named at a hook site (``fire``/``suppressed``/
+    ``.site ==`` comparisons) must be in the SITES registry, every
+    registered site must have a live hook in the tree (a dead table entry
+    means a renamed hook silently orphaned every plan naming it), and the
+    site-action validity table must cover exactly the registered sites."""
+    sites, table, reg_path = _find_fault_registry(project)
+    if reg_path is None:
+        return []
+    findings: list[Finding] = []
+    used = fault_site_usages(project.modules, exclude_path=reg_path)
+    for name, refs in sorted(used.items()):
+        if name not in sites:
+            for path, line in refs:
+                findings.append(Finding(
+                    path, line, "fault-site", SEVERITY_ERROR,
+                    f"fault site {name!r} is not registered in "
+                    "faults/plan.py SITES",
+                    "register the site (and its valid actions) or fix "
+                    "the hook's name"))
+    for name, (path, line) in sorted(sites.items()):
+        if name not in used:
+            findings.append(Finding(
+                path, line, "fault-site", SEVERITY_ERROR,
+                f"registered fault site {name!r} has no hook site in the "
+                "scanned tree",
+                "a plan naming it would validate but never fire — remove "
+                "the dead entry or restore the hook"))
+    for name, (path, line) in sorted(table.items()):
+        if name not in sites:
+            findings.append(Finding(
+                path, line, "fault-site", SEVERITY_ERROR,
+                f"_SITE_ACTIONS names unregistered site {name!r}",
+                "keep the validity table keyed exactly by SITES"))
+    for name, (path, line) in sorted(sites.items()):
+        if name not in table:
+            findings.append(Finding(
+                path, line, "fault-site", SEVERITY_ERROR,
+                f"site {name!r} has no _SITE_ACTIONS entry",
+                "every site needs its valid-action row"))
+    return findings
+
+
+def fault_sites_in_tree(root: str | None = None) -> frozenset[str]:
+    """Hook-site names actually wired in the package tree — the render-
+    time registry ``launch/validate.py`` checks fault plans against, so a
+    plan naming a site whose hook was renamed/removed fails at render
+    time instead of silently never firing. *root* overrides the scanned
+    directory (tests point it at synthetic trees)."""
+    if root is None:
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    modules, _ = load_modules([root])
+    project = Project(modules)
+    _, _, reg_path = _find_fault_registry(project)
+    return frozenset(fault_site_usages(modules, exclude_path=reg_path))
+
+
+# ----------------------------------------------------------------- registry
+
+PASSES: tuple[PassSpec, ...] = (
+    PassSpec("recompile",
+             "Python branching/concretization on traced values; jit "
+             "wrappers built per-iteration", pass_recompile),
+    PassSpec("collective-axis",
+             "collective axis names checked against shard_map/Mesh "
+             "declarations (the deadlock class)", pass_collective_axis),
+    PassSpec("host-sync",
+             "device->host syncs inside traced regions and serving/"
+             "training hot paths", pass_host_sync),
+    PassSpec("rank-divergence",
+             "wall-clock/RNG/env/set-order inputs feeding collectively-"
+             "executed code", pass_rank_divergence),
+    PassSpec("event-registry",
+             "emit() event names vs telemetry/events.py, both directions",
+             pass_event_registry),
+    PassSpec("fault-site",
+             "fault hook sites vs faults/plan.py SITES table, both "
+             "directions", pass_fault_site),
+)
+
+PASS_IDS = tuple(p.id for p in PASSES)

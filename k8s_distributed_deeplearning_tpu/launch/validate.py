@@ -72,11 +72,32 @@ def _check_container(errors, where: str, c: dict) -> None:
     _check_fault_plan(errors, where, c)
 
 
+def _hooked_sites() -> frozenset[str]:
+    """Site names with a LIVE hook in the package tree, via graftlint's
+    fault-site scanner (cached: the AST scan costs ~1s and the tree does
+    not change under a validate call)."""
+    global _HOOKED_SITES
+    if _HOOKED_SITES is None:
+        from k8s_distributed_deeplearning_tpu.analysis import (
+            fault_sites_in_tree)
+        _HOOKED_SITES = fault_sites_in_tree()
+    return _HOOKED_SITES
+
+
+_HOOKED_SITES: frozenset[str] | None = None
+
+
 def _check_fault_plan(errors, where: str, c: dict) -> None:
     """A manifest carrying $TPUJOB_FAULT_PLAN must carry a VALID plan —
     a typo'd plan silently not firing would pass a chaos run vacuously.
     ``@/path`` values are structural (the file lives in the container's
-    filesystem, not here), so only inline JSON is parsed."""
+    filesystem, not here), so only inline JSON is parsed.
+
+    Beyond the plan's own registry check, every site must also have a
+    live hook in the code tree (graftlint pass 6's scan): a site can be
+    valid per ``faults/plan.py`` SITES yet orphaned — its ``fire()`` call
+    renamed or deleted — in which case the fault would validate fine and
+    then silently never fire."""
     for e in c.get("env", []):
         if e.get("name") != "TPUJOB_FAULT_PLAN" or "value" not in e:
             continue
@@ -84,10 +105,19 @@ def _check_fault_plan(errors, where: str, c: dict) -> None:
         if not raw or raw.startswith("@"):
             continue
         try:
-            FaultPlan.from_json(raw).validate_or_raise()
+            plan = FaultPlan.from_json(raw)
+            plan.validate_or_raise()
         except (ValueError, TypeError) as ex:
             _err(errors, where, f"TPUJOB_FAULT_PLAN is not a valid fault "
                  f"plan: {ex}")
+            continue
+        hooked = _hooked_sites()
+        for f in plan.faults:
+            if f.site not in hooked:
+                _err(errors, where,
+                     f"TPUJOB_FAULT_PLAN names site {f.site!r} which has "
+                     f"no live hook in the code tree (hooked: "
+                     f"{sorted(hooked)}) — the fault would never fire")
 
 
 def validate(docs: list[dict]) -> list[str]:

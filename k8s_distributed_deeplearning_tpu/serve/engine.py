@@ -468,10 +468,13 @@ class ServeEngine:
                 self.model, self.params, self._cache, self._tokens,
                 self._kv_lens, self._temps, self._top_ks, self._top_ps,
                 self._keys)
-            nxt = np.asarray(nxt)   # the iteration's honest host sync
+            # graftlint: disable=host-sync — the iteration's one honest
+            # sync: every slot's sampled token in a single device fence.
+            nxt = np.asarray(nxt)
             # np.array (copy), not np.asarray: the zero-copy view of a jax
             # CPU buffer is read-only, and admissions write per-slot keys
             # in place.
+            # graftlint: disable=host-sync — rides the same fence as nxt
             self._keys = np.array(keys)
         self.stats.record_step(active, self.num_slots)
         for slot, fl in enumerate(self._slots):

@@ -27,7 +27,10 @@ EVENTS: dict[str, str] = {
     "serve_request": "one serving request completed: tokens, TTFT, latency",
     "serve_summary": "end-of-run serving aggregate: tokens/sec, percentiles",
     "span": "a traced span closed: name, dur_ms, depth, parent, rank",
+    # graftlint: disable=event-registry — heartbeat/stall are written by
+    # the heartbeat file plane and `launch watch`, not via .emit().
     "heartbeat": "per-rank liveness record (also written as heartbeat files)",
+    # graftlint: disable=event-registry — see above
     "stall": "watch flagged a rank with a stale heartbeat",
     "ckpt_quarantined": "restore found a corrupt/torn checkpoint step and "
                         "moved it aside; falling back to an older step",

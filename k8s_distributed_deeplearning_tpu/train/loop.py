@@ -32,6 +32,7 @@ PyTree = Any
 _NULL_TRACER = Tracer(enabled=False)
 
 
+# graftlint: hot-path
 def fit(
     step_fn: Callable,                # (state, batch, rng) -> (state, loss, aux)
     state: PyTree,                    # TrainState (step counter at .step)
@@ -145,6 +146,8 @@ def fit(
                 return state
 
         if metrics and log_every and (step + 1) % log_every == 0:
+            # graftlint: disable=host-sync — the one intentional sync, at
+            # log cadence only: everything between logs stays async.
             loss_f = float(loss)  # blocks: this is the host sync point
             now = time.monotonic()
             window = step + 1 - step_last
@@ -154,6 +157,7 @@ def fit(
             eps = (global_batch_size or 0) / (dt_ms / 1e3) if global_batch_size else 0.0
             extra = {}
             for k, v in (aux or {}).items():
+                # graftlint: disable=host-sync — rides the log-cadence sync
                 extra[k] = float(v)
             m = None
             if flops_per_example and peak_flops:
@@ -166,6 +170,8 @@ def fit(
                                  mfu=m)
 
         if eval_fn is not None and eval_every and (step + 1) % eval_every == 0:
+            # graftlint: disable=host-sync — eval results are read at eval
+            # cadence; blocking here is the point.
             ev = {k: float(v) for k, v in eval_fn(state).items()}
             if metrics:
                 metrics.emit("eval", step=step + 1, **ev)

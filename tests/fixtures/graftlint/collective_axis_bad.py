@@ -1,0 +1,14 @@
+"""Positive fixture: collective axis name absent from the enclosing
+shard_map's declared axes (the deadlock class)."""
+import jax
+from jax.experimental.shard_map import shard_map
+from jax.sharding import PartitionSpec as P
+
+
+def _mean(x):
+    return jax.lax.pmean(x, axis_name="dtaa")    # typo: mesh says "data"
+
+
+def build(mesh):
+    return shard_map(_mean, mesh=mesh, in_specs=P("data", "model"),
+                     out_specs=P("data", "model"))

@@ -1,0 +1,15 @@
+"""Suppressed twin of collective_axis_bad.py."""
+import jax
+from jax.experimental.shard_map import shard_map
+from jax.sharding import PartitionSpec as P
+
+
+def _mean(x):
+    # graftlint: disable=collective-axis — axis is bound dynamically in
+    # the test harness, not by this mesh
+    return jax.lax.pmean(x, axis_name="dtaa")
+
+
+def build(mesh):
+    return shard_map(_mean, mesh=mesh, in_specs=P("data", "model"),
+                     out_specs=P("data", "model"))

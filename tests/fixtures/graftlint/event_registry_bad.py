@@ -1,0 +1,12 @@
+"""Positive fixture: an emit site using a name absent from the EVENTS
+registry, and a registered name with no emit site."""
+EVENTS: dict[str, str] = {
+    "start": "run began",
+    "restore": "checkpoint restore-on-start",
+}
+
+
+def log(metrics):
+    metrics.emit("start", step=0)
+    metrics.emit("strat", step=0)        # typo'd event name
+    # ("restore" has no emit site -> dead-entry finding on the registry)

@@ -1,0 +1,7 @@
+"""Positive fixture (hook half): a hook firing a site name that is not
+in the SITES registry. "shard_read" is never fired -> dead table entry."""
+
+
+def loop(inj):
+    inj.fire("step", step=0)
+    inj.fire("stepp", step=1)            # typo'd hook-site name

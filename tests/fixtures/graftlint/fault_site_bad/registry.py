@@ -1,0 +1,9 @@
+"""Positive fixture (registry half): SITES declares a site with no hook
+anywhere in the tree, and the validity table drifts from SITES."""
+SITES = ("step", "shard_read")
+
+_SITE_ACTIONS = {
+    "step": ("delay", "except"),
+    # "shard_read" missing here -> no valid-action row
+    "ghost": ("delay",),                 # table names an unregistered site
+}

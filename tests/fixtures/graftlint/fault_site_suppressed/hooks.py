@@ -1,0 +1,7 @@
+"""Suppressed twin of fault_site_bad/hooks.py."""
+
+
+def loop(inj):
+    inj.fire("step", step=0)
+    # graftlint: disable=fault-site — fixture: pretend it's registered
+    inj.fire("stepp", step=1)

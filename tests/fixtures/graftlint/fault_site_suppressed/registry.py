@@ -1,0 +1,12 @@
+"""Suppressed twin of fault_site_bad/registry.py."""
+SITES = (
+    "step",
+    # graftlint: disable=fault-site — hook lives out-of-tree in a plugin
+    "shard_read",
+)
+
+_SITE_ACTIONS = {
+    "step": ("delay", "except"),
+    # graftlint: disable=fault-site — plugin-owned row
+    "ghost": ("delay",),
+}

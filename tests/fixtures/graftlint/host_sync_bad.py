@@ -1,0 +1,17 @@
+"""Positive fixture: host synchronization inside a traced region and on
+a marked hot path."""
+import jax
+import numpy as np
+
+
+@jax.jit
+def program(x):
+    y = x * 2
+    return np.asarray(y)                 # concretizes inside the trace
+
+
+# graftlint: hot-path
+def decode_loop(step_fn, state):
+    state, logits = step_fn(state)
+    worst = float(logits[0])             # per-token device fence
+    return state, worst

@@ -1,0 +1,17 @@
+"""Suppressed twin of host_sync_bad.py."""
+import jax
+import numpy as np
+
+
+@jax.jit
+def program(x):
+    y = x * 2
+    # graftlint: disable=host-sync — fixture: pretend this is intentional
+    return np.asarray(y)
+
+
+# graftlint: hot-path
+def decode_loop(step_fn, state):
+    state, logits = step_fn(state)
+    worst = float(logits[0])             # graftlint: disable=host-sync
+    return state, worst

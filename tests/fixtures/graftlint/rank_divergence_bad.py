@@ -1,0 +1,12 @@
+"""Positive fixture: rank-divergent inputs inside collectively-executed
+code (every rank must trace and branch identically)."""
+import random
+import time
+
+import jax
+
+
+def sync_mean(x, axis_name="data"):
+    t0 = time.time()                     # clocks differ across ranks
+    jitter = random.random()             # process-local RNG
+    return jax.lax.pmean(x * (t0 + jitter), axis_name=axis_name)

@@ -1,0 +1,13 @@
+"""Suppressed twin of rank_divergence_bad.py."""
+import random
+import time
+
+import jax
+
+
+def sync_mean(x, axis_name="data"):
+    t0 = time.time()                     # graftlint: disable=rank-divergence
+    # graftlint: disable=rank-divergence — seeded identically per rank in
+    # the fixture's pretend harness
+    jitter = random.random()
+    return jax.lax.pmean(x * (t0 + jitter), axis_name=axis_name)

@@ -1,0 +1,20 @@
+"""Positive fixture: recompile hazards inside a jitted function."""
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnames=("flag",))
+def step(x, threshold, *, flag):
+    if threshold > 0:                    # Python branch on a traced value
+        x = x * 2
+    total = float(jnp.sum(x))            # concretizes under the trace
+    return x, total
+
+
+def build_many(fns, x):
+    out = []
+    for f in fns:
+        out.append(jax.jit(f)(x))        # fresh wrapper per iteration
+    return out

@@ -1,0 +1,22 @@
+"""Suppressed twin of recompile_bad.py."""
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnames=("flag",))
+def step(x, threshold, *, flag):
+    if threshold > 0:                    # graftlint: disable=recompile
+        x = x * 2
+    # graftlint: disable=recompile — value is logged once at trace time
+    total = float(jnp.sum(x))
+    return x, total
+
+
+def build_many(fns, x):
+    out = []
+    for f in fns:
+        # graftlint: disable=recompile
+        out.append(jax.jit(f)(x))
+    return out

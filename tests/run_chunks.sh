@@ -38,9 +38,12 @@ CHUNKS[serve]="tests/test_serve.py tests/test_prefix_cache.py tests/test_telemet
 # The chaos matrix spawns real training gangs (subprocess per attempt), so
 # it gets its own chunk rather than riding in deploy.
 CHUNKS[faults]="tests/test_faults.py"
+# graftlint (pure-AST, no jax at analysis time): cheap, so it runs first —
+# a schema/axis/hot-path regression fails in seconds, not after compiles.
+CHUNKS[lint]="tests/test_analysis.py"
 CHUNKS[slow1]="tests/test_train_e2e.py tests/test_multiprocess.py"
 CHUNKS[slow2]="tests/test_multihost_train.py tests/test_multihost_llama.py tests/test_train_zoo.py"
-ORDER=(core parallel1 parallel2 moe train llama deploy serve faults slow1 slow2)
+ORDER=(lint core parallel1 parallel2 moe train llama deploy serve faults slow1 slow2)
 
 # --- completeness check: every tests/test_*.py in EXACTLY one chunk ------
 # ...and every declared chunk actually in ORDER: a chunk missing from the

@@ -1,0 +1,168 @@
+"""graftlint CI gate: the committed zero-findings baseline, the per-pass
+fixture matrix, the CLI exit-code contract, and the pure-AST budget.
+
+The fixture matrix is the proof each hazard class is both caught and
+suppressible: for every pass id there is a positive fixture (must yield
+at least one finding, all of that pass) and a suppressed twin (same code,
+inline ``# graftlint: disable=`` comments, zero active findings). The
+real-tree test is the gate itself — any new unsuppressed finding in the
+package tree fails CI with the finding's file:line in the message.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from k8s_distributed_deeplearning_tpu import analysis
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+FIXDIR = os.path.join(HERE, "fixtures", "graftlint")
+
+# pass id -> fixture stem (ids use dashes, filenames underscores)
+STEMS = {pid: pid.replace("-", "_") for pid in analysis.PASS_IDS}
+
+
+def fixture_paths(pass_id: str, kind: str) -> list[str]:
+    """The positive ("bad") or suppressed fixture for a pass: a single
+    file, or a directory for multi-file fixtures (fault-site needs the
+    registry and the hooks in separate modules, like the real tree)."""
+    base = os.path.join(FIXDIR, f"{STEMS[pass_id]}_{kind}")
+    if os.path.isdir(base):
+        return [base]
+    assert os.path.isfile(base + ".py"), f"missing fixture {base}.py"
+    return [base + ".py"]
+
+
+def run_cli(*argv: str) -> subprocess.CompletedProcess:
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.run(
+        [sys.executable, "-m", "k8s_distributed_deeplearning_tpu.analysis",
+         *argv],
+        capture_output=True, text=True, env=env, timeout=120)
+
+
+# --------------------------------------------------------- fixture matrix
+
+@pytest.mark.parametrize("pass_id", analysis.PASS_IDS)
+def test_positive_fixture_fires(pass_id):
+    report = analysis.run(fixture_paths(pass_id, "bad"))
+    assert report.findings, f"positive fixture for {pass_id} found nothing"
+    got = {f.pass_id for f in report.findings}
+    assert got == {pass_id}, (
+        f"fixture for {pass_id} leaked findings from other passes: {got}")
+    for f in report.findings:
+        assert f.line > 0 and f.path and f.message and f.hint
+
+
+@pytest.mark.parametrize("pass_id", analysis.PASS_IDS)
+def test_suppressed_twin_is_clean(pass_id):
+    report = analysis.run(fixture_paths(pass_id, "suppressed"))
+    assert report.ok, (
+        f"suppressed twin for {pass_id} still fires:\n"
+        + "\n".join(f.format() for f in report.findings))
+    assert any(f.pass_id == pass_id for f in report.suppressed), (
+        f"suppressed twin for {pass_id} suppressed nothing — the "
+        "suppression comment is not actually covering a finding")
+
+
+@pytest.mark.parametrize("pass_id", analysis.PASS_IDS)
+def test_cli_nonzero_on_positive_fixture(pass_id):
+    proc = run_cli(*fixture_paths(pass_id, "bad"))
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert f"[{pass_id}]" in proc.stdout
+
+
+# ------------------------------------------------------ the real-tree gate
+
+def test_package_tree_has_zero_unsuppressed_findings():
+    t0 = time.monotonic()
+    report = analysis.run()
+    elapsed = time.monotonic() - t0
+    assert report.ok, (
+        "graftlint found unsuppressed hazards in the tree — fix them or "
+        "suppress with a justification comment:\n"
+        + "\n".join(f.format() for f in report.findings))
+    # The suppressed set is the audited exception list; it only ever
+    # changes deliberately.
+    assert report.suppressed, "expected the audited suppressions to exist"
+    assert elapsed < 10.0, f"full-tree lint took {elapsed:.1f}s (budget 10s)"
+
+
+def test_cli_exit_zero_on_package_tree():
+    proc = run_cli()
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "0 findings" in proc.stdout
+
+
+# ------------------------------------------------------- the CLI contract
+
+def test_cli_usage_errors_exit_2():
+    proc = run_cli("--select", "no-such-pass")
+    assert proc.returncode == 2
+    assert "no-such-pass" in proc.stderr
+    proc = run_cli(os.path.join(FIXDIR, "does_not_exist.py"))
+    assert proc.returncode == 2
+
+
+def test_cli_list_passes():
+    proc = run_cli("--list-passes")
+    assert proc.returncode == 0
+    for pid in analysis.PASS_IDS:
+        assert pid in proc.stdout
+
+
+def test_cli_select_scopes_the_run():
+    # The recompile fixture under a non-matching pass: clean exit.
+    proc = run_cli("--select", "event-registry",
+                   *fixture_paths("recompile", "bad"))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_run_rejects_unknown_pass_ids():
+    with pytest.raises(ValueError, match="unknown pass id"):
+        analysis.run(select=("recompile", "bogus"))
+
+
+def test_finding_format_contract():
+    f = analysis.Finding("a/b.py", 7, "host-sync", "error", "msg", "do x")
+    assert f.format() == "a/b.py:7: [host-sync] error: msg (hint: do x)"
+    assert analysis.Finding("a.py", 1, "p", "error", "m").format() == \
+        "a.py:1: [p] error: m"
+
+
+def test_parse_errors_become_findings(tmp_path):
+    bad = tmp_path / "broken.py"
+    bad.write_text("def f(:\n")
+    report = analysis.run([str(bad)])
+    assert not report.ok
+    assert report.findings[0].pass_id == "parse"
+
+
+# --------------------------------------------------- the pure-AST contract
+
+def test_analysis_package_never_imports_jax():
+    """The acceptance criterion that keeps the linter runnable anywhere:
+    no module in analysis/ may import jax (or numpy — pure stdlib)."""
+    pkg = os.path.join(os.path.dirname(HERE),
+                       "k8s_distributed_deeplearning_tpu", "analysis")
+    banned = {"jax", "numpy", "flax", "optax", "orbax"}
+    for name in sorted(os.listdir(pkg)):
+        if not name.endswith(".py"):
+            continue
+        with open(os.path.join(pkg, name), encoding="utf-8") as fh:
+            tree = ast.parse(fh.read(), filename=name)
+        for node in ast.walk(tree):
+            roots = set()
+            if isinstance(node, ast.Import):
+                roots = {a.name.split(".")[0] for a in node.names}
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                roots = {node.module.split(".")[0]}
+            hit = roots & banned
+            assert not hit, (
+                f"analysis/{name}:{node.lineno} imports {sorted(hit)} — "
+                "the analysis package is pure-AST by contract")

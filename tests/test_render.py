@@ -153,3 +153,31 @@ def test_invalid_fault_plan_fails_validation():
     errs = validate.validate(render.render_all(
         JobConfig(num_workers=2, fault_plan=bad)))
     assert any("TPUJOB_FAULT_PLAN" in e and "not valid" in e for e in errs)
+
+
+def test_fault_plan_site_without_live_hook_fails_validation(monkeypatch):
+    """A site can be registered in faults/plan.py SITES — so the plan's
+    own validation passes — while its fire() hook was renamed away, in
+    which case the fault silently never fires. Render-time validation
+    cross-checks every plan site against graftlint's scan of live hooks
+    (here narrowed via monkeypatch: on the real tree all sites are
+    hooked, which the second half asserts)."""
+    import json
+
+    from k8s_distributed_deeplearning_tpu.launch import validate
+
+    plan = json.dumps({"faults": [{"site": "step", "action": "exit",
+                                   "rank": 0, "step": 100}]})
+    docs = render.render_all(JobConfig(num_workers=2, fault_plan=plan))
+    # Pretend the tree's only live hook is serve_decode: "step" is still
+    # a valid SITES entry, but now orphaned -> must fail validation.
+    monkeypatch.setattr(validate, "_HOOKED_SITES",
+                        frozenset({"serve_decode"}))
+    errs = validate.validate(docs)
+    assert any("no live hook" in e and "'step'" in e for e in errs)
+    # Real tree: every registered site has a live hook, so the same plan
+    # validates clean (this is also what graftlint pass 6 gates in CI).
+    monkeypatch.setattr(validate, "_HOOKED_SITES", None)
+    assert validate.validate(docs) == []
+    from k8s_distributed_deeplearning_tpu.faults.plan import SITES
+    assert set(SITES) <= validate._hooked_sites()
