@@ -805,6 +805,125 @@ def measure_serve_overhead(n_requests: int = 8, num_slots: int = 4,
     }
 
 
+def measure_serve_sched(n_batch: int = 12, n_interactive: int = 4,
+                        num_slots: int = 4, batch_prompt: int = 64,
+                        batch_out: int = 24, inter_prompt: int = 16,
+                        inter_out: int = 8, inject_every: int = 4,
+                        seed: int = 0) -> dict:
+    """SLO isolation under a batch flood: *n_batch* long requests are
+    queued upfront and *n_interactive* short requests arrive mid-stream
+    (one every *inject_every* engine iterations). FCFS arm: the legacy
+    single queue — each arrival waits behind the whole remaining flood.
+    Sched arm: an interactive-priority tenant plus a batch tenant slot-
+    capped at num_slots-1, so one slot's worth of capacity is always
+    available to the latency-sensitive class. Reports interactive p95
+    latency per arm and the ratio (the ISSUE's >= 2x gate)."""
+    import numpy as np
+
+    from k8s_distributed_deeplearning_tpu.serve import (Request, ServeEngine,
+                                                        TenantConfig)
+
+    max_seq = batch_prompt + batch_out + 32
+    model, params, cfg, on_cpu = _serve_cpu_model(max_seq)
+    rng = np.random.default_rng(seed)
+    batch_prompts = [rng.integers(0, cfg.vocab_size, size=batch_prompt)
+                     .astype(np.int32) for _ in range(n_batch)]
+    inter_prompts = [rng.integers(0, cfg.vocab_size, size=inter_prompt)
+                     .astype(np.int32) for _ in range(n_interactive)]
+
+    def run(tenants):
+        eng = ServeEngine(model, params, num_slots=num_slots,
+                          max_queue=n_batch + n_interactive,
+                          tenants=tenants)
+        bt = "bulk" if tenants else "default"
+        it = "chat" if tenants else "default"
+        for p in batch_prompts:
+            eng.submit(Request(prompt=p, max_new_tokens=batch_out,
+                               tenant=bt))
+        inter = [Request(prompt=p, max_new_tokens=inter_out, tenant=it)
+                 for p in inter_prompts]
+        outs, steps, injected = [], 0, 0
+        while eng.busy() or injected < len(inter):
+            if injected < len(inter) and steps % inject_every == 0:
+                eng.submit(inter[injected])
+                injected += 1
+            outs.extend(eng.step())
+            steps += 1
+        by_id = {o.request_id: o for o in outs}
+        lats = sorted(by_id[r.request_id].latency_s for r in inter)
+        return float(lats[min(len(lats) - 1,
+                              int(round(0.95 * (len(lats) - 1))))])
+
+    tenants = [TenantConfig("chat", priority="interactive"),
+               TenantConfig("bulk", priority="batch",
+                            max_slots=num_slots - 1)]
+    run(None)                                  # warmup replays (compiles)
+    run(tenants)
+    fcfs_p95 = run(None)
+    sched_p95 = run(tenants)
+    return {
+        "sched_interactive_p95_ms_fcfs": round(fcfs_p95 * 1e3, 1),
+        "sched_interactive_p95_ms_sched": round(sched_p95 * 1e3, 1),
+        "sched_interactive_p95_speedup": round(fcfs_p95 / sched_p95, 2),
+        "sched_config": {
+            "n_batch": n_batch, "n_interactive": n_interactive,
+            "slots": num_slots, "batch_prompt": batch_prompt,
+            "batch_out": batch_out, "inter_out": inter_out,
+            "inject_every": inject_every,
+            "model": ("cpu-serve (dim 256, 4L, 32k vocab, f32)" if on_cpu
+                      else "llama-small 124M bf16"),
+        },
+    }
+
+
+def measure_serve_sched_overhead(n_requests: int = 8, num_slots: int = 4,
+                                 out_len: int = 48, repeats: int = 3,
+                                 seed: int = 0) -> dict:
+    """Single-tenant scheduler overhead: the TenantScheduler with the one
+    unlimited default tenant (the out-of-the-box config) vs the legacy
+    FCFS RequestQueue swapped in behind the same engine — the measured
+    delta is the policy core's heap/DRR bookkeeping on the admission
+    path. Same interleaved min-of-repeats discipline as
+    measure_serve_overhead; the sched-suite gate asserts < 2%."""
+    import numpy as np
+
+    from k8s_distributed_deeplearning_tpu.serve import (Request,
+                                                        RequestQueue,
+                                                        ServeEngine)
+
+    max_seq = 256
+    model, params, cfg, _ = _serve_cpu_model(max_seq)
+    rng = np.random.default_rng(seed)
+    prompts = [rng.integers(0, cfg.vocab_size, size=int(
+        rng.integers(32, 128))).astype(np.int32) for _ in range(n_requests)]
+
+    def run(fcfs: bool) -> float:
+        eng = ServeEngine(model, params, num_slots=num_slots,
+                          max_queue=n_requests)
+        if fcfs:
+            eng.queue = RequestQueue(n_requests)   # the A/B swap
+        reqs = [Request(prompt=p, max_new_tokens=out_len) for p in prompts]
+        t0 = time.perf_counter()
+        eng.run(reqs)
+        return (time.perf_counter() - t0) / max(eng.stats.steps, 1)
+
+    run(True)                                  # warmup replays (compiles)
+    run(False)
+    times = {"fcfs": float("inf"), "sched": float("inf")}
+    for _ in range(repeats):
+        times["fcfs"] = min(times["fcfs"], run(True))
+        times["sched"] = min(times["sched"], run(False))
+    pct = (times["sched"] - times["fcfs"]) / times["fcfs"] * 100.0
+    return {
+        "sched_single_tenant_overhead_pct": round(pct, 3),
+        "serve_step_ms_fcfs": round(times["fcfs"] * 1e3, 4),
+        "serve_step_ms_sched": round(times["sched"] * 1e3, 4),
+        "sched_overhead_config": {"requests": n_requests,
+                                  "slots": num_slots, "out_len": out_len,
+                                  "repeats": repeats},
+    }
+
+
 def measure_telemetry_overhead(steps: int = 30, warmup: int = 5,
                                batch_size: int = 512,
                                repeats: int = 3) -> dict:
@@ -1126,7 +1245,7 @@ def main() -> None:
     ap.add_argument("--batch-size", type=int, default=16384)
     ap.add_argument("--suite",
                     choices=["all", "mnist", "llama", "attention", "zoo",
-                             "decode", "moe", "serve", "telemetry",
+                             "decode", "moe", "serve", "sched", "telemetry",
                              "recovery"],
                     default="all")
     ap.add_argument("--cpu-baseline", action="store_true",
@@ -1186,6 +1305,31 @@ def main() -> None:
             "unit": "tokens/sec",
             "vs_baseline": extra["serve_speedup_vs_static"],
             "extra": extra})
+        return
+    if args.suite == "sched":
+        extra = measure_serve_sched()
+        extra.update(measure_serve_sched_overhead())
+        emit({
+            "metric": "sched_interactive_p95_speedup",
+            "value": extra["sched_interactive_p95_speedup"],
+            "unit": "x (interactive p95 latency, FCFS / DRR+EDF, "
+                    "under batch flood)",
+            "vs_baseline": None,
+            "extra": extra})
+        # The ISSUE's absolute gates, independent of the stored baseline:
+        # isolation must be worth >= 2x and must cost < 2% when unused.
+        gates = []
+        if extra["sched_interactive_p95_speedup"] < 2.0:
+            gates.append("GATE sched_interactive_p95_speedup: "
+                         f"{extra['sched_interactive_p95_speedup']} < 2.0")
+        if extra["sched_single_tenant_overhead_pct"] >= 2.0:
+            gates.append("GATE sched_single_tenant_overhead_pct: "
+                         f"{extra['sched_single_tenant_overhead_pct']}"
+                         " >= 2.0")
+        for g in gates:
+            print(g, file=sys.stderr)
+        if gates:
+            sys.exit(2)
         return
     if args.suite == "telemetry":
         extra = measure_telemetry_overhead(steps=args.steps,

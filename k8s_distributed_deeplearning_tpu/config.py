@@ -120,6 +120,10 @@ class JobConfig:
     # $TPUJOB_FAULT_PLAN (inline JSON, or "@/path" to a mounted file) —
     # the chaos-test rendering path (faults/plan.py). None renders no env.
     fault_plan: str | None = None
+    # Optional multi-tenant scheduler config carried into serving workers
+    # as $TPUJOB_TENANTS (inline JSON, or "@/path" to a mounted file) —
+    # serve/sched/tenant.py parses it. None renders no env (FCFS default).
+    tenants: str | None = None
 
     def chips_per_worker(self) -> int:
         """TPU chips each pod must request: the slice's chip total (product of

@@ -69,6 +69,10 @@ def render_tpujob(cfg: JobConfig) -> dict:
         # Chaos-test runs carry their fault plan in the manifest itself,
         # so the rendered object fully describes the experiment.
         env.append({"name": "TPUJOB_FAULT_PLAN", "value": cfg.fault_plan})
+    if cfg.tenants:
+        # Serving jobs carry their tenant/SLO config the same way — the
+        # manifest fully describes the scheduling policy under test.
+        env.append({"name": "TPUJOB_TENANTS", "value": cfg.tenants})
     container = {
         "name": "worker",
         "image": cfg.image,

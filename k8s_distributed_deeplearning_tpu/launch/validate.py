@@ -70,6 +70,7 @@ def _check_container(errors, where: str, c: dict) -> None:
                      f"{kind}.{res} quantity {qty!r} is not a valid "
                      "Kubernetes resource quantity")
     _check_fault_plan(errors, where, c)
+    _check_tenants(errors, where, c)
 
 
 def _hooked_sites() -> frozenset[str]:
@@ -118,6 +119,29 @@ def _check_fault_plan(errors, where: str, c: dict) -> None:
                      f"TPUJOB_FAULT_PLAN names site {f.site!r} which has "
                      f"no live hook in the code tree (hooked: "
                      f"{sorted(hooked)}) — the fault would never fire")
+
+
+def _check_tenants(errors, where: str, c: dict) -> None:
+    """A manifest carrying $TPUJOB_TENANTS must carry a VALID tenant
+    config — same contract as the fault-plan check: a typo'd config
+    (unknown key, duplicate id, nonpositive weight/rate) failing only at
+    serving-worker startup wastes a scheduled TPU slice. ``@/path``
+    values are structural (the file lives in the container's filesystem,
+    not here), so only inline JSON is parsed. Lazy import keeps validate
+    usable without the serve package's dependencies loaded up front."""
+    for e in c.get("env", []):
+        if e.get("name") != "TPUJOB_TENANTS" or "value" not in e:
+            continue
+        raw = (e.get("value") or "").strip()
+        if not raw or raw.startswith("@"):
+            continue
+        from k8s_distributed_deeplearning_tpu.serve.sched.tenant import (
+            parse_tenants)
+        try:
+            parse_tenants(raw)
+        except (ValueError, TypeError) as ex:
+            _err(errors, where,
+                 f"TPUJOB_TENANTS is not a valid tenant config: {ex}")
 
 
 def validate(docs: list[dict]) -> list[str]:

@@ -10,7 +10,10 @@ from k8s_distributed_deeplearning_tpu.serve.engine import ServeEngine
 from k8s_distributed_deeplearning_tpu.serve.prefix_cache import PrefixCache
 from k8s_distributed_deeplearning_tpu.serve.request import (
     QueueFull, Request, RequestOutput, SamplingParams)
+from k8s_distributed_deeplearning_tpu.serve.sched import (
+    DEFAULT_TENANT, TenantConfig, TenantScheduler, load_tenants)
 from k8s_distributed_deeplearning_tpu.serve.scheduler import RequestQueue
 
 __all__ = ["ServeEngine", "Request", "RequestOutput", "SamplingParams",
-           "RequestQueue", "QueueFull", "PrefixCache"]
+           "RequestQueue", "QueueFull", "PrefixCache", "TenantConfig",
+           "TenantScheduler", "DEFAULT_TENANT", "load_tenants"]

@@ -49,6 +49,12 @@ def main(argv: list[str] | None = None) -> int:
                     help="bound each iteration's prefill work to this many "
                          "prompt tokens (0 = off); must be a multiple of "
                          "the 32-token prefill bucket granularity")
+    ap.add_argument("--tenants", default=None, metavar="SPEC",
+                    help="multi-tenant scheduler config: inline JSON or "
+                         "@/path to a JSON file (same addressing as fault "
+                         "plans). Workload requests are assigned round-"
+                         "robin across the configured tenants; omitted = "
+                         "single unlimited default tenant (FCFS)")
     ap.add_argument("--temperature", type=float, default=0.0)
     ap.add_argument("--top-k", type=int, default=0)
     ap.add_argument("--top-p", type=float, default=1.0)
@@ -86,10 +92,18 @@ def main(argv: list[str] | None = None) -> int:
     import numpy as np
 
     from k8s_distributed_deeplearning_tpu.models import llama
-    from k8s_distributed_deeplearning_tpu.serve import (Request,
+    from k8s_distributed_deeplearning_tpu.serve import (QueueFull, Request,
                                                         SamplingParams,
-                                                        ServeEngine)
+                                                        ServeEngine,
+                                                        load_tenants)
     from k8s_distributed_deeplearning_tpu.utils.metrics import MetricsLogger
+
+    tenant_cfgs = None
+    if args.tenants:
+        try:
+            tenant_cfgs = load_tenants(args.tenants)
+        except (OSError, ValueError) as e:
+            ap.error(f"--tenants: {e}")
 
     if args.preset == "small":
         cfg = llama.config_tiny(
@@ -120,7 +134,7 @@ def main(argv: list[str] | None = None) -> int:
     engine = ServeEngine(
         model, params, num_slots=args.slots,
         max_queue=args.max_queue or args.requests,
-        eos_id=args.eos_id, tracer=tracer,
+        eos_id=args.eos_id, tracer=tracer, tenants=tenant_cfgs,
         prefill_chunk_tokens=args.prefill_chunk_tokens or None,
         prefix_cache_mb=args.prefix_cache_mb or None)
     exporter = None
@@ -132,20 +146,35 @@ def main(argv: list[str] | None = None) -> int:
             MetricsRegistry)
         registry = MetricsRegistry()
         bridge.serving_collector(registry, engine.stats)
+        bridge.sched_collector(registry, engine.queue)
         exporter = MetricsExporter(registry, port=args.metrics_port).start()
     shared = rng.integers(0, cfg.vocab_size, size=args.shared_prefix_len)
+    tenant_ids = engine.queue.tenant_ids()
+    from collections import deque
+    feed = deque()
     for i in range(args.requests):
         prompt = rng.integers(0, cfg.vocab_size,
                               size=int(rng.integers(p_lo, p_hi + 1)))
         prompt = np.concatenate([shared, prompt])
-        engine.submit(Request(
+        feed.append(Request(
             prompt=prompt.astype(np.int32),
             max_new_tokens=int(rng.integers(o_lo, o_hi + 1)),
-            sampling=sampling, seed=args.seed + i))
+            sampling=sampling, seed=args.seed + i,
+            tenant=tenant_ids[i % len(tenant_ids)]))
 
     # Drive iteration-by-iteration so completions stream out as they
-    # happen — the same loop a network front-end would run.
-    while engine.busy():
+    # happen — the same loop a network front-end would run. Requests are
+    # fed under back-pressure: a tenant whose bounded queue is full sheds
+    # (logged) and the front end retries it after the next iteration.
+    while feed or engine.busy():
+        while feed:
+            try:
+                engine.submit(feed[0])
+            except QueueFull:
+                logger.emit("sched_shed", tenant=feed[0].tenant,
+                            request_id=feed[0].request_id, retried=True)
+                break
+            feed.popleft()
         for out in engine.step():
             logger.emit("serve_request", request_id=out.request_id,
                         prompt_len=out.prompt_len,
@@ -158,6 +187,10 @@ def main(argv: list[str] | None = None) -> int:
                         latency_ms=round(out.latency_s * 1e3, 3))
     logger.emit("serve_summary", num_slots=args.slots,
                 preset=args.preset, **engine.stats.summary())
+    if tenant_cfgs is not None:
+        snap = engine.queue.snapshot()
+        for tid, t in snap["tenants"].items():
+            logger.emit("sched_tenant_summary", tenant=tid, **t)
     logger.close()
     if exporter is not None:
         exporter.stop()

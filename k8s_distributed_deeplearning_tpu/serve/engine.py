@@ -61,6 +61,7 @@ from __future__ import annotations
 
 import functools
 import time
+from collections import deque
 from typing import Any, Iterable
 
 import jax
@@ -71,8 +72,9 @@ from k8s_distributed_deeplearning_tpu import faults as _faults
 from k8s_distributed_deeplearning_tpu.models import generate
 from k8s_distributed_deeplearning_tpu.serve.prefix_cache import PrefixCache
 from k8s_distributed_deeplearning_tpu.serve.request import (
-    Request, RequestOutput)
-from k8s_distributed_deeplearning_tpu.serve.scheduler import RequestQueue
+    QueueFull, Request, RequestOutput)
+from k8s_distributed_deeplearning_tpu.serve.sched import (
+    TenantConfig, TenantScheduler)
 from k8s_distributed_deeplearning_tpu.telemetry.trace import Tracer
 from k8s_distributed_deeplearning_tpu.utils.metrics import ServingStats
 
@@ -284,6 +286,14 @@ class ServeEngine:
     trie; ``prefill_chunk_tokens`` (None = off) bounds each iteration's
     prefill work to that many real prompt tokens (must be a positive
     multiple of ``min_bucket``, the prefill bucket granularity).
+
+    ``tenants`` (optional) configures the SLO-aware multi-tenant
+    scheduler (serve/sched): per-tenant EDF queues drained by
+    deficit-weighted round-robin under strict priority classes, with
+    token-bucket rate limits and max-concurrent-slot quotas enforced at
+    admission. None registers the single unlimited default tenant —
+    behaviorally the FCFS queue this engine always had. ``max_queue``
+    bounds each tenant that does not set its own ``max_queue``.
     """
 
     def __init__(self, model, params: PyTree, *, num_slots: int = 8,
@@ -292,6 +302,7 @@ class ServeEngine:
                  prefill_chunk_tokens: int | None = None,
                  prefix_cache_mb: float | None = None,
                  prefix_block_tokens: int | None = None,
+                 tenants: Iterable[TenantConfig] | None = None,
                  stats: ServingStats | None = None,
                  tracer: Tracer | None = None):
         if num_slots < 2:
@@ -327,7 +338,7 @@ class ServeEngine:
         # chunk + splice) and "decode" (one arena-wide decode iteration
         # incl. the host sync).
         self.tracer = tracer if tracer is not None else _NULL_TRACER
-        self.queue = RequestQueue(max_queue)
+        self.queue = TenantScheduler(tenants, default_max_queue=max_queue)
         # Per-slot register file (host numpy; fixed dtypes so the decode
         # program's operand signature — and thus its compilation — never
         # changes). kv_lens doubles as the next write position.
@@ -397,8 +408,10 @@ class ServeEngine:
     # ---------------------------------------------------------------- API
 
     def submit(self, req: Request) -> str:
-        """Queue a request (FCFS). Raises QueueFull when the bounded queue
-        is at capacity and ValueError for requests that could never run."""
+        """Queue a request under its tenant's policy. Raises QueueFull —
+        scoped to the offending tenant — when that tenant's bounded queue
+        is at capacity, and ValueError for requests that could never run
+        (or that name an unregistered tenant)."""
         n = len(req.prompt)
         if n < 1:
             raise ValueError("empty prompt")
@@ -411,6 +424,7 @@ class ServeEngine:
                 f"exceeds max_seq_len ({self.max_seq_len}) — the slot's KV "
                 "region would overflow")
         req._t_submit = time.perf_counter()
+        req._finished = False        # re-arm the exactly-once on_finish latch
         self.queue.submit(req)
         return req.request_id
 
@@ -446,6 +460,11 @@ class ServeEngine:
         for slot in list(self._pending):
             if self._expired(self._pending[slot].req, now):
                 outputs.append(self._cancel_pending(slot, "timeout"))
+        # Queue-time deadline sweep: requests already dead stop consuming
+        # queue capacity (and their tenant's EDF head) NOW, not when a
+        # free slot happens to pop them.
+        for req in self.queue.sweep_expired(now):
+            outputs.append(self._timeout_unadmitted(req))
         self.last_step_prefill_tokens = 0
         self._step_prefill_budget = self.prefill_chunk_tokens
         # Admission and prefill alternate until neither makes progress:
@@ -497,14 +516,31 @@ class ServeEngine:
     def run(self, requests: Iterable[Request] | None = None,
             max_steps: int | None = None) -> list[RequestOutput]:
         """Submit *requests* (optional) and step until queue, prefills and
-        slots are all drained. Returns outputs in completion order."""
-        if requests is not None:
-            for r in requests:
-                self.submit(r)
+        slots are all drained. Returns outputs in completion order.
+
+        Requests are FED as capacity frees rather than submitted upfront:
+        a list longer than the queue bound pauses the feed on QueueFull
+        and resumes after completions, instead of raising mid-run."""
+        feed: deque[Request] = (deque(requests) if requests is not None
+                                else deque())
         outputs: list[RequestOutput] = []
         steps = 0
-        while self.busy():
-            outputs.extend(self.step())
+        while True:
+            while feed:
+                try:
+                    self.submit(feed[0])
+                except QueueFull:
+                    break            # back-pressure: resume after this step
+                feed.popleft()
+            if not (self.busy() or feed):
+                break
+            outs = self.step()
+            outputs.extend(outs)
+            if (not outs and len(self.queue) and not self._pending
+                    and not any(s is not None for s in self._slots)):
+                # Every queued tenant is rate-limited right now: nothing
+                # decodes, so yield briefly while the buckets refill.
+                time.sleep(0.001)
             steps += 1
             if max_steps is not None and steps >= max_steps:
                 break
@@ -523,8 +559,7 @@ class ServeEngine:
                 request_id=req.request_id, prompt_len=len(req.prompt),
                 tokens=[], finish_reason="aborted", queue_s=now - t0,
                 ttft_s=None, latency_s=now - t0))
-            if req.on_finish is not None:
-                req.on_finish("aborted")
+            self._notify_finish(req, "aborted")
         for slot in list(self._pending):
             outs.append(self._cancel_pending(slot, "aborted"))
         for slot, fl in enumerate(self._slots):
@@ -558,6 +593,18 @@ class ServeEngine:
                 and now - req._t_submit > req.deadline_s)
 
     @staticmethod
+    def _notify_finish(req: Request, reason: str) -> None:
+        """Fire ``on_finish`` EXACTLY once per submission. Every terminal
+        path funnels through here: shutdown racing a deadline expiry (or
+        a second shutdown) must not tell a streaming client its request
+        ended twice. The latch re-arms on resubmit."""
+        if req._finished:
+            return
+        req._finished = True
+        if req.on_finish is not None:
+            req.on_finish(reason)
+
+    @staticmethod
     def _timeout_unadmitted(req: Request) -> RequestOutput:
         """Terminal output for a request whose deadline expired while it
         was still queued — no slot, no tokens, no prefill spent on it."""
@@ -567,8 +614,7 @@ class ServeEngine:
             request_id=req.request_id, prompt_len=len(req.prompt),
             tokens=[], finish_reason="timeout", queue_s=now - t0,
             ttft_s=None, latency_s=now - t0)
-        if req.on_finish is not None:
-            req.on_finish("timeout")
+        ServeEngine._notify_finish(req, "timeout")
         return out
 
     def _bucket(self, n: int) -> int:
@@ -579,12 +625,17 @@ class ServeEngine:
 
     def _admit_free_slots(self, outputs: list[RequestOutput]) -> None:
         """Pop queued requests into free, non-pending slots (expired ones
-        complete as "timeout" without costing prefill)."""
+        complete as "timeout" without costing prefill). ``pop() -> None``
+        with a non-empty queue means every queued tenant is rate- or
+        quota-blocked right now — no slot will do better, so stop."""
         for slot in range(self.num_slots):
             while (self._slots[slot] is None and slot not in self._pending
                    and len(self.queue)):
                 req = self.queue.pop()
+                if req is None:
+                    return
                 if self._expired(req, time.perf_counter()):
+                    self.queue.release(req)   # popped = slot reserved
                     outputs.append(self._timeout_unadmitted(req))
                     continue        # expired in queue; try the next one
                 self._begin_admission(slot, req)
@@ -736,8 +787,8 @@ class ServeEngine:
             cached_prompt_tokens=pend.hit_tokens)
         self.stats.record_completion(latency_s=out.latency_s, n_tokens=0,
                                      reason=reason)
-        if pend.req.on_finish is not None:
-            pend.req.on_finish(reason)
+        self.queue.release(pend.req)
+        self._notify_finish(pend.req, reason)
         return out
 
     def _finish(self, slot: int, reason: str) -> RequestOutput:
@@ -758,6 +809,6 @@ class ServeEngine:
         self._top_ps[slot] = 1.0
         self.stats.record_completion(latency_s=out.latency_s,
                                      n_tokens=len(out.tokens), reason=reason)
-        if fl.req.on_finish is not None:
-            fl.req.on_finish(reason)
+        self.queue.release(fl.req)
+        self._notify_finish(fl.req, reason)
         return out

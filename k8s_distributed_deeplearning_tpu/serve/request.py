@@ -57,6 +57,10 @@ class Request:
         default_factory=lambda: f"req-{next(_req_counter)}")
     seed: int = 0
     on_token: Callable[[int], None] | None = None
+    # Which tenant this request bills against (serve/sched): picks its
+    # queue, priority class, rate limit and slot quota. The default
+    # tenant always exists, so single-tenant callers never set this.
+    tenant: str = "default"
     # Wall-clock budget measured from submit: once exceeded, the engine
     # cancels the request at the next decode boundary (finish_reason
     # "timeout", slot freed) — a hung/vanished client cannot pin a slot
@@ -71,6 +75,11 @@ class Request:
     # TTFT are measured from this instant.
     _t_submit: float | None = dataclasses.field(
         default=None, repr=False, compare=False)
+    # Exactly-once latch for on_finish (set by ServeEngine._notify_finish,
+    # cleared on resubmit): shutdown racing a deadline expiry must not
+    # fire the terminal callback twice.
+    _finished: bool = dataclasses.field(
+        default=False, repr=False, compare=False)
 
 
 @dataclasses.dataclass

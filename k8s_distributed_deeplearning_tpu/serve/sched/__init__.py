@@ -1,0 +1,19 @@
+"""SLO-aware multi-tenant scheduling for the serving engine.
+
+Splits admission policy from admission mechanics: :mod:`tenant` is the
+declarative registry (priority class, DRR weight, token-bucket rate
+limit, slot quota, queue bound — JSON-loadable and render-validated),
+:mod:`policy` is the runtime (per-tenant EDF heaps drained by
+deficit-weighted round-robin under strict priority, with per-tenant
+back-pressure and a queue-time deadline sweep). The engine talks to
+:class:`TenantScheduler` through the same ``submit()/pop()`` surface the
+FCFS queue had, so policy changes never touch the decode path.
+"""
+from k8s_distributed_deeplearning_tpu.serve.sched.policy import (
+    TenantScheduler)
+from k8s_distributed_deeplearning_tpu.serve.sched.tenant import (
+    DEFAULT_TENANT, PRIORITY_CLASSES, TenantConfig, load_tenants,
+    parse_tenants)
+
+__all__ = ["TenantScheduler", "TenantConfig", "DEFAULT_TENANT",
+           "PRIORITY_CLASSES", "load_tenants", "parse_tenants"]

@@ -1,0 +1,164 @@
+"""Tenant registry: the declarative half of the SLO-aware scheduler.
+
+A :class:`TenantConfig` names everything the policy core
+(:mod:`serve.sched.policy`) needs to isolate one traffic class from
+another:
+
+- ``priority``        strict-priority class ("interactive" > "normal" >
+                      "batch"): a lower class is only served when every
+                      higher class is empty or blocked by its own limits.
+- ``weight``          deficit-weighted round-robin share *within* the
+                      class, in service tokens (prompt + max_new_tokens)
+                      — a weight-2 tenant gets twice the admitted tokens
+                      of a weight-1 tenant under sustained contention.
+- ``rate_tokens_per_s`` / ``burst_tokens``
+                      token-bucket rate limit in service tokens. The
+                      bucket starts full (``burst_tokens``, default one
+                      second of refill), refills continuously while
+                      idle but never above the burst cap, and admits a
+                      request when it holds ``min(cost, burst)`` tokens
+                      (oversized requests run on a full bucket and push
+                      the bucket into debt, so they still pay their true
+                      cost in wait time). ``None`` = unlimited.
+- ``max_slots``       concurrent decode/prefill slots this tenant may
+                      hold — the quota that keeps a flood of admitted
+                      long requests from occupying the whole arena.
+- ``max_queue``       per-tenant admission-queue bound: the tenant whose
+                      clients outrun their budget gets :class:`QueueFull`
+                      back-pressure; everyone else keeps submitting.
+
+Tenant-config files travel exactly like fault plans: inline JSON or an
+``@/path`` reference, carried as ``$TPUJOB_TENANTS`` by the rendered
+manifest (``JobConfig.tenants`` → ``launch/render.py``) and validated
+offline at render time (``launch/validate.py``). Schema::
+
+    {"tenants": [
+        {"id": "chat", "priority": "interactive", "weight": 4,
+         "rate_tokens_per_s": 2000, "burst_tokens": 8000,
+         "max_slots": 6, "max_queue": 64},
+        {"id": "backfill", "priority": "batch", "weight": 1}
+    ]}
+
+Unknown keys, duplicate ids and nonpositive weights/rates are rejected
+with the exact reason — a typo'd tenant file must fail at render time,
+not silently run everyone at defaults.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+
+# Strict-priority ranks, best first. Index = scheduling rank.
+PRIORITY_CLASSES = ("interactive", "normal", "batch")
+
+#: Tenant every :class:`serve.request.Request` belongs to unless it says
+#: otherwise — a single-tenant engine is just this tenant alone.
+DEFAULT_TENANT = "default"
+
+
+@dataclasses.dataclass(frozen=True)
+class TenantConfig:
+    """One tenant's admission contract (see module docstring)."""
+
+    tenant_id: str
+    priority: str = "normal"
+    weight: float = 1.0
+    rate_tokens_per_s: float | None = None
+    burst_tokens: float | None = None
+    max_slots: int | None = None
+    max_queue: int | None = None
+
+    def __post_init__(self):
+        if not self.tenant_id or not isinstance(self.tenant_id, str):
+            raise ValueError(f"tenant_id must be a non-empty string, got "
+                             f"{self.tenant_id!r}")
+        if self.priority not in PRIORITY_CLASSES:
+            raise ValueError(
+                f"tenant {self.tenant_id!r}: priority {self.priority!r} is "
+                f"not one of {PRIORITY_CLASSES}")
+        if not self.weight > 0:
+            raise ValueError(f"tenant {self.tenant_id!r}: weight must be "
+                             f"> 0, got {self.weight}")
+        if self.rate_tokens_per_s is not None and not self.rate_tokens_per_s > 0:
+            raise ValueError(
+                f"tenant {self.tenant_id!r}: rate_tokens_per_s must be > 0 "
+                f"(None = unlimited), got {self.rate_tokens_per_s}")
+        if self.burst_tokens is not None:
+            if not self.burst_tokens > 0:
+                raise ValueError(
+                    f"tenant {self.tenant_id!r}: burst_tokens must be > 0, "
+                    f"got {self.burst_tokens}")
+            if self.rate_tokens_per_s is None:
+                raise ValueError(
+                    f"tenant {self.tenant_id!r}: burst_tokens without "
+                    "rate_tokens_per_s is meaningless (no bucket to cap)")
+        if self.max_slots is not None and self.max_slots < 1:
+            raise ValueError(f"tenant {self.tenant_id!r}: max_slots must be "
+                             f">= 1, got {self.max_slots}")
+        if self.max_queue is not None and self.max_queue < 1:
+            raise ValueError(f"tenant {self.tenant_id!r}: max_queue must be "
+                             f">= 1, got {self.max_queue}")
+
+    @property
+    def burst(self) -> float | None:
+        """Effective bucket capacity: ``burst_tokens``, defaulting to one
+        second of refill when only the rate is set."""
+        if self.rate_tokens_per_s is None:
+            return None
+        return (self.burst_tokens if self.burst_tokens is not None
+                else self.rate_tokens_per_s)
+
+
+# JSON key -> TenantConfig field ("id" is the wire spelling of tenant_id).
+_JSON_KEYS = {"id": "tenant_id", "priority": "priority", "weight": "weight",
+              "rate_tokens_per_s": "rate_tokens_per_s",
+              "burst_tokens": "burst_tokens", "max_slots": "max_slots",
+              "max_queue": "max_queue"}
+
+
+def parse_tenants(text: str) -> tuple[TenantConfig, ...]:
+    """Parse + validate an inline-JSON tenant config. Raises ValueError
+    with the exact defect (bad JSON, wrong shape, unknown keys, duplicate
+    ids, out-of-range values) — the contract ``launch/validate.py``
+    surfaces at render time."""
+    try:
+        doc = json.loads(text)
+    except json.JSONDecodeError as e:
+        raise ValueError(f"tenant config is not valid JSON: {e}") from e
+    if not isinstance(doc, dict) or not isinstance(doc.get("tenants"), list):
+        raise ValueError('tenant config must be {"tenants": [...]}, got '
+                         f"{type(doc).__name__}")
+    out: list[TenantConfig] = []
+    seen: set[str] = set()
+    for i, rec in enumerate(doc["tenants"]):
+        if not isinstance(rec, dict):
+            raise ValueError(f"tenants[{i}] is not an object")
+        unknown = set(rec) - set(_JSON_KEYS)
+        if unknown:
+            raise ValueError(
+                f"tenants[{i}] has unknown fields {sorted(unknown)} "
+                f"(known: {sorted(_JSON_KEYS)})")
+        if "id" not in rec:
+            raise ValueError(f"tenants[{i}] is missing 'id'")
+        try:
+            cfg = TenantConfig(**{_JSON_KEYS[k]: v for k, v in rec.items()})
+        except (ValueError, TypeError) as e:
+            raise ValueError(f"tenants[{i}]: {e}") from e
+        if cfg.tenant_id in seen:
+            raise ValueError(f"tenants[{i}]: duplicate tenant id "
+                             f"{cfg.tenant_id!r}")
+        seen.add(cfg.tenant_id)
+        out.append(cfg)
+    if not out:
+        raise ValueError("tenant config lists no tenants")
+    return tuple(out)
+
+
+def load_tenants(spec: str) -> tuple[TenantConfig, ...]:
+    """Resolve a tenant-config spec: inline JSON, or ``@/path`` to a JSON
+    file (the same addressing fault plans use for ``$TPUJOB_FAULT_PLAN``)."""
+    spec = spec.strip()
+    if spec.startswith("@"):
+        with open(spec[1:]) as f:
+            spec = f.read()
+    return parse_tenants(spec)

@@ -1,10 +1,19 @@
-"""Bounded FCFS admission queue.
+"""Bounded FCFS admission queue — the LEGACY single-policy scheduler.
 
-Deliberately minimal: admission ORDER is the whole policy (first come,
-first served into whichever slot frees up), and the bound is the
-back-pressure surface — a full queue raises :class:`QueueFull` at submit
-time instead of buffering unboundedly. Priority/fair-share policies would
-slot in here without touching the engine.
+Admission policy grew into its own subsystem in :mod:`serve.sched`: the
+engine now constructs a :class:`serve.sched.TenantScheduler` (per-tenant
+EDF queues, deficit-weighted round-robin across tenants, strict priority
+classes, token-bucket rate limits, slot quotas, per-tenant back-pressure)
+behind the same ``submit()/pop()`` surface this class defined. With a
+single unlimited default tenant that scheduler degenerates to exactly
+this queue's behavior, which is what the ``bench.py --suite sched``
+overhead gate measures this class against.
+
+:class:`RequestQueue` remains as the minimal reference implementation of
+the scheduler surface — ``submit``/``pop``/``drain``/``__len__`` plus
+no-op ``sweep_expired``/``release`` (FCFS has no queue-time deadline
+index and no quotas to return) — so it stays drop-in assignable to
+``ServeEngine.queue`` for A/B comparisons.
 
 With chunked prefill a popped request may spend several engine iterations
 as a *pending prefill* before its slot decodes (serve/engine.py
@@ -37,6 +46,14 @@ class RequestQueue:
 
     def pop(self) -> Request | None:
         return self._q.popleft() if self._q else None
+
+    def sweep_expired(self, now: float | None = None) -> list[Request]:
+        """FCFS keeps no deadline index: expired requests are detected at
+        pop time instead (the engine's backstop check)."""
+        return []
+
+    def release(self, req: Request) -> None:
+        """FCFS tracks no per-tenant slot quota: nothing to return."""
 
     def drain(self) -> list[Request]:
         out = list(self._q)

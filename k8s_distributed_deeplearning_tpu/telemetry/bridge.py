@@ -158,6 +158,54 @@ def serving_collector(registry: MetricsRegistry,
     registry.register_collector(collect)
 
 
+def sched_collector(registry: MetricsRegistry, sched) -> None:
+    """Register a pull-time collector over the multi-tenant scheduler's
+    :meth:`serve.sched.TenantScheduler.snapshot`: per-tenant queue depth,
+    shed/expiry counts and slots held, plus per-priority-class depth and
+    queue-wait p95 — the gauges the Grafana tenant panel and a
+    replica-routing front end read. Same zero-push discipline as
+    :func:`serving_collector`: nothing happens on the pop path."""
+    t_depth = registry.gauge(
+        "sched_queue_depth", "queued requests per tenant",
+        labelnames=("tenant",))
+    t_shed = registry.gauge(
+        "sched_shed_total",
+        "submits rejected by per-tenant back-pressure", labelnames=("tenant",))
+    t_expired = registry.gauge(
+        "sched_expired_total",
+        "requests swept from the queue past their deadline",
+        labelnames=("tenant",))
+    t_slots = registry.gauge(
+        "sched_slots_in_use", "decode/prefill slots held per tenant",
+        labelnames=("tenant",))
+    t_wait = registry.gauge(
+        "sched_queue_wait_p95_ms",
+        "queue wait p95 per tenant (sliding window)", labelnames=("tenant",))
+    c_depth = registry.gauge(
+        "sched_class_queue_depth", "queued requests per priority class",
+        labelnames=("priority",))
+    c_wait = registry.gauge(
+        "sched_class_queue_wait_p95_ms",
+        "queue wait p95 per priority class (sliding window)",
+        labelnames=("priority",))
+
+    def collect() -> None:
+        snap = sched.snapshot()
+        for tid, t in snap["tenants"].items():
+            t_depth.labels(tenant=tid).set(t["queue_depth"])
+            t_shed.labels(tenant=tid).set(t["shed_total"])
+            t_expired.labels(tenant=tid).set(t["expired_total"])
+            t_slots.labels(tenant=tid).set(t["in_flight"])
+            if t["queue_wait_p95_ms"] is not None:
+                t_wait.labels(tenant=tid).set(t["queue_wait_p95_ms"])
+        for cls, c in snap["classes"].items():
+            c_depth.labels(priority=cls).set(c["queue_depth"])
+            if c["queue_wait_p95_ms"] is not None:
+                c_wait.labels(priority=cls).set(c["queue_wait_p95_ms"])
+
+    registry.register_collector(collect)
+
+
 def heartbeat_collector(registry: MetricsRegistry, directory: str) -> None:
     """Expose heartbeat ages as ``tpujob_heartbeat_age_seconds{rank=...}``
     — the Grafana stall panel's instant vector (run it wherever the

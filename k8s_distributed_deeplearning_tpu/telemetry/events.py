@@ -32,6 +32,10 @@ EVENTS: dict[str, str] = {
     "heartbeat": "per-rank liveness record (also written as heartbeat files)",
     # graftlint: disable=event-registry — see above
     "stall": "watch flagged a rank with a stale heartbeat",
+    "sched_shed": "a tenant's bounded admission queue rejected a submit "
+                  "(per-tenant back-pressure; tenant attached)",
+    "sched_tenant_summary": "end-of-run per-tenant scheduler aggregate: "
+                            "queue waits, sheds, expiries, slots held",
     "ckpt_quarantined": "restore found a corrupt/torn checkpoint step and "
                         "moved it aside; falling back to an older step",
     "crash_loop": "consecutive restarts died without checkpoint progress; "

@@ -35,6 +35,10 @@ CHUNKS[train]="tests/test_mnist_convergence.py tests/test_grad_accum.py tests/te
 CHUNKS[llama]="tests/test_train_llama.py tests/test_generate.py"
 CHUNKS[deploy]="tests/test_watch.py tests/test_render.py tests/test_deploy_smoke.py tests/test_elastic.py tests/test_preemption.py tests/test_cluster_e2e.py"
 CHUNKS[serve]="tests/test_serve.py tests/test_prefix_cache.py tests/test_telemetry.py tests/test_events_schema.py"
+# Multi-tenant scheduler: mostly model-free policy tests plus a handful of
+# engine-integration cases (own tiny-model compile), split out so the serve
+# chunk stays under its timeout.
+CHUNKS[sched]="tests/test_sched.py"
 # The chaos matrix spawns real training gangs (subprocess per attempt), so
 # it gets its own chunk rather than riding in deploy.
 CHUNKS[faults]="tests/test_faults.py"
@@ -43,7 +47,7 @@ CHUNKS[faults]="tests/test_faults.py"
 CHUNKS[lint]="tests/test_analysis.py"
 CHUNKS[slow1]="tests/test_train_e2e.py tests/test_multiprocess.py"
 CHUNKS[slow2]="tests/test_multihost_train.py tests/test_multihost_llama.py tests/test_train_zoo.py"
-ORDER=(lint core parallel1 parallel2 moe train llama deploy serve faults slow1 slow2)
+ORDER=(lint core parallel1 parallel2 moe train llama deploy serve sched faults slow1 slow2)
 
 # --- completeness check: every tests/test_*.py in EXACTLY one chunk ------
 # ...and every declared chunk actually in ORDER: a chunk missing from the

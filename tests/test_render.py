@@ -181,3 +181,49 @@ def test_fault_plan_site_without_live_hook_fails_validation(monkeypatch):
     assert validate.validate(docs) == []
     from k8s_distributed_deeplearning_tpu.faults.plan import SITES
     assert set(SITES) <= validate._hooked_sites()
+
+
+def test_tenants_render_env_and_validate():
+    """JobConfig.tenants rides into the manifest as TPUJOB_TENANTS — the
+    serving job's SLO policy is fully described by the rendered object —
+    and a well-formed config passes offline validation. Same contract as
+    fault plans: @/path values are structural, absence renders no env."""
+    import json
+
+    from k8s_distributed_deeplearning_tpu.launch import validate
+
+    doc = json.dumps({"tenants": [
+        {"id": "chat", "priority": "interactive", "weight": 4,
+         "rate_tokens_per_s": 2000, "max_slots": 6},
+        {"id": "backfill", "priority": "batch", "max_queue": 32}]})
+    docs = render.render_all(JobConfig(num_workers=2, tenants=doc))
+    env = {e["name"]: e for e in
+           docs[2]["spec"]["template"]["spec"]["containers"][0]["env"]}
+    assert env["TPUJOB_TENANTS"]["value"] == doc
+    assert validate.validate(docs) == []
+    docs = render.render_all(JobConfig(num_workers=2))
+    names = {e["name"] for e in
+             docs[2]["spec"]["template"]["spec"]["containers"][0]["env"]}
+    assert "TPUJOB_TENANTS" not in names
+    docs = render.render_all(JobConfig(num_workers=2,
+                                       tenants="@/mnt/tenants.json"))
+    assert validate.validate(docs) == []
+
+
+def test_invalid_tenants_fail_validation():
+    """A tenant config with bad JSON, an unknown key, a duplicate id, or a
+    nonpositive weight is a render-time error, not a serving worker that
+    dies at startup on a scheduled TPU slice."""
+    import json
+
+    from k8s_distributed_deeplearning_tpu.launch import validate
+
+    for bad in (
+            "{not json",
+            json.dumps({"tenants": [{"id": "a", "colour": "red"}]}),
+            json.dumps({"tenants": [{"id": "a"}, {"id": "a"}]}),
+            json.dumps({"tenants": [{"id": "a", "weight": -1}]})):
+        errs = validate.validate(render.render_all(
+            JobConfig(num_workers=2, tenants=bad)))
+        assert any("TPUJOB_TENANTS" in e and "not a valid" in e
+                   for e in errs), (bad, errs)

@@ -1,0 +1,454 @@
+"""SLO-aware multi-tenant scheduler (serve/sched): tenant-config parsing,
+DRR weight shares, EDF ordering, token-bucket edge cases, slot quotas,
+per-tenant back-pressure, and the engine integration — including the
+run() feed regression, exactly-once on_finish, and an overload chaos
+matrix driven through the fault-injection harness."""
+import time
+
+import numpy as np
+import pytest
+
+from k8s_distributed_deeplearning_tpu.serve import (QueueFull, Request,
+                                                    TenantConfig,
+                                                    TenantScheduler,
+                                                    load_tenants)
+from k8s_distributed_deeplearning_tpu.serve.sched.tenant import parse_tenants
+
+
+class FakeClock:
+    """Deterministic injectable clock for token-bucket/EDF tests."""
+
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def _req(prompt_len=8, max_new=8, tenant="default", deadline_s=None):
+    return Request(prompt=np.zeros(prompt_len, np.int32),
+                   max_new_tokens=max_new, tenant=tenant,
+                   deadline_s=deadline_s)
+
+
+def _sched(*cfgs, **kw):
+    return TenantScheduler(list(cfgs) or None, clock=FakeClock(), **kw)
+
+
+# --------------------------------------------------------------- config
+
+
+def test_tenant_config_validation():
+    with pytest.raises(ValueError, match="tenant_id"):
+        TenantConfig("")
+    with pytest.raises(ValueError, match="priority"):
+        TenantConfig("a", priority="urgent")
+    with pytest.raises(ValueError, match="weight"):
+        TenantConfig("a", weight=0)
+    with pytest.raises(ValueError, match="rate_tokens_per_s"):
+        TenantConfig("a", rate_tokens_per_s=-1)
+    with pytest.raises(ValueError, match="burst_tokens"):
+        TenantConfig("a", burst_tokens=100)   # burst without a rate
+    with pytest.raises(ValueError, match="max_slots"):
+        TenantConfig("a", max_slots=0)
+    with pytest.raises(ValueError, match="max_queue"):
+        TenantConfig("a", max_queue=0)
+    # burst defaults to one second of refill
+    assert TenantConfig("a", rate_tokens_per_s=50.0).burst == 50.0
+    assert TenantConfig("a", rate_tokens_per_s=50.0,
+                        burst_tokens=200.0).burst == 200.0
+    assert TenantConfig("a").burst is None
+
+
+def test_parse_tenants_schema_errors():
+    ok = parse_tenants('{"tenants": [{"id": "chat", "priority": '
+                       '"interactive", "weight": 2}]}')
+    assert len(ok) == 1 and ok[0].tenant_id == "chat"
+    assert ok[0].priority == "interactive" and ok[0].weight == 2.0
+    for bad, msg in [
+            ('not json', "JSON"),
+            ('[]', "tenants"),
+            ('{"tenants": []}', "no tenants"),
+            ('{"tenants": ["x"]}', "object"),
+            ('{"tenants": [{"priority": "batch"}]}', "id"),
+            ('{"tenants": [{"id": "a", "color": "red"}]}', "color"),
+            ('{"tenants": [{"id": "a"}, {"id": "a"}]}', "duplicate"),
+            ('{"tenants": [{"id": "a", "weight": -2}]}', "weight")]:
+        with pytest.raises(ValueError, match=msg):
+            parse_tenants(bad)
+
+
+def test_load_tenants_inline_and_file(tmp_path):
+    doc = '{"tenants": [{"id": "t1"}, {"id": "t2", "max_slots": 3}]}'
+    assert [c.tenant_id for c in load_tenants(doc)] == ["t1", "t2"]
+    p = tmp_path / "tenants.json"
+    p.write_text(doc)
+    cfgs = load_tenants(f"@{p}")
+    assert [c.tenant_id for c in cfgs] == ["t1", "t2"]
+    assert cfgs[1].max_slots == 3
+    with pytest.raises(OSError):
+        load_tenants(f"@{tmp_path}/missing.json")
+
+
+# ----------------------------------------------------------- policy core
+
+
+def test_default_tenant_is_fifo():
+    s = _sched()
+    reqs = [_req() for _ in range(5)]
+    for r in reqs:
+        s.submit(r)
+    assert len(s) == 5
+    popped = [s.pop() for _ in range(5)]
+    assert [r.request_id for r in popped] == [r.request_id for r in reqs]
+    assert s.pop() is None and len(s) == 0
+
+
+def test_edf_orders_within_tenant():
+    s = _sched()
+    late = _req(deadline_s=60.0)
+    none1 = _req()                      # no deadline sorts last, FIFO
+    soon = _req(deadline_s=5.0)
+    none2 = _req()
+    for r in (late, none1, soon, none2):
+        s.submit(r)
+    order = [s.pop().request_id for _ in range(4)]
+    assert order == [soon.request_id, late.request_id,
+                     none1.request_id, none2.request_id]
+
+
+def test_unknown_tenant_rejected():
+    s = _sched(TenantConfig("a"))
+    with pytest.raises(ValueError, match="unknown tenant"):
+        s.submit(_req(tenant="ghost"))
+
+
+def test_per_tenant_queuefull_isolation():
+    s = _sched(TenantConfig("small", max_queue=2), TenantConfig("big"))
+    s.submit(_req(tenant="small"))
+    s.submit(_req(tenant="small"))
+    with pytest.raises(QueueFull, match="small"):
+        s.submit(_req(tenant="small"))
+    # The other tenant is unaffected by its neighbor's back-pressure.
+    for _ in range(8):
+        s.submit(_req(tenant="big"))
+    snap = s.snapshot()["tenants"]
+    assert snap["small"]["shed_total"] == 1
+    assert snap["big"]["shed_total"] == 0
+    # Popping frees the bounded tenant's capacity again.
+    assert s.pop() is not None
+    s.submit(_req(tenant="small"))
+
+
+def test_drr_weight_shares():
+    """Under a sustained backlog of equal-cost requests, admitted service
+    tokens converge to the configured weights (3:1 within 15%)."""
+    s = _sched(TenantConfig("heavy", weight=3.0),
+               TenantConfig("light", weight=1.0))
+    for _ in range(200):
+        s.submit(_req(prompt_len=16, max_new=16, tenant="heavy"))
+        s.submit(_req(prompt_len=16, max_new=16, tenant="light"))
+    served = {"heavy": 0, "light": 0}
+    for _ in range(120):               # pop while BOTH stay backlogged
+        r = s.pop()
+        served[r.tenant] += len(r.prompt) + r.max_new_tokens
+        s.release(r)
+    ratio = served["heavy"] / served["light"]
+    assert 3.0 * 0.85 <= ratio <= 3.0 * 1.15, served
+
+
+def test_drr_cost_counters_long_requests():
+    """Equal weights but 4x longer requests on one tenant: DRR equalizes
+    *tokens*, so the long tenant gets ~1/4 the request count."""
+    s = _sched(TenantConfig("long", weight=1.0),
+               TenantConfig("short", weight=1.0))
+    for _ in range(200):
+        s.submit(_req(prompt_len=48, max_new=16, tenant="long"))    # 64
+        s.submit(_req(prompt_len=8, max_new=8, tenant="short"))     # 16
+    counts = {"long": 0, "short": 0}
+    for _ in range(150):
+        r = s.pop()
+        counts[r.tenant] += 1
+        s.release(r)
+    ratio = counts["short"] / counts["long"]
+    assert 4.0 * 0.8 <= ratio <= 4.0 * 1.2, counts
+
+
+def test_strict_priority_classes():
+    s = _sched(TenantConfig("bg", priority="batch"),
+               TenantConfig("fg", priority="interactive"),
+               TenantConfig("mid", priority="normal"))
+    for t in ("bg", "bg", "mid", "fg"):
+        s.submit(_req(tenant=t))
+    assert s.pop().tenant == "fg"
+    assert s.pop().tenant == "mid"
+    assert s.pop().tenant == "bg"
+    # A blocked higher class lets the lower class through.
+    s2 = _sched(TenantConfig("fg", priority="interactive", max_slots=1),
+                TenantConfig("bg", priority="batch"))
+    s2.submit(_req(tenant="fg"))
+    s2.submit(_req(tenant="fg"))
+    s2.submit(_req(tenant="bg"))
+    first = s2.pop()
+    assert first.tenant == "fg"
+    assert s2.pop().tenant == "bg"     # fg at its slot quota
+    s2.release(first)
+    assert s2.pop().tenant == "fg"     # quota returned
+
+
+def test_token_bucket_burst_then_block():
+    clk = FakeClock()
+    s = TenantScheduler([TenantConfig("t", rate_tokens_per_s=100.0,
+                                      burst_tokens=40.0)], clock=clk)
+    for _ in range(4):
+        s.submit(_req(prompt_len=10, max_new=10, tenant="t"))   # cost 20
+    assert s.pop() is not None          # bucket starts full: 40 -> 20
+    assert s.pop() is not None          # 20 -> 0
+    assert s.pop() is None and len(s) == 2   # blocked, not empty
+    clk.advance(0.1)                    # +10 tokens: still < 20
+    assert s.pop() is None
+    clk.advance(0.1)                    # +10 more: exactly 20
+    assert s.pop() is not None
+
+
+def test_token_bucket_idle_refill_caps_at_burst():
+    clk = FakeClock()
+    s = TenantScheduler([TenantConfig("t", rate_tokens_per_s=100.0,
+                                      burst_tokens=40.0)], clock=clk)
+    clk.advance(3600.0)                 # an hour idle refills to 40, not 360k
+    for _ in range(3):
+        s.submit(_req(prompt_len=10, max_new=10, tenant="t"))
+    assert s.pop() is not None and s.pop() is not None
+    assert s.pop() is None              # the cap held: only 2 bursts' worth
+
+
+def test_token_bucket_oversized_request_runs_on_debt():
+    """cost > burst admits on a full bucket (never starves) and drives the
+    bucket negative — the next request pays the debt in wait time."""
+    clk = FakeClock()
+    s = TenantScheduler([TenantConfig("t", rate_tokens_per_s=10.0,
+                                      burst_tokens=20.0)], clock=clk)
+    s.submit(_req(prompt_len=40, max_new=10, tenant="t"))        # cost 50
+    s.submit(_req(prompt_len=5, max_new=5, tenant="t"))          # cost 10
+    big = s.pop()
+    assert big is not None and len(big.prompt) == 40
+    assert s.snapshot()["tenants"]["t"]["rate_tokens_available"] == -30.0
+    assert s.pop() is None              # in debt
+    clk.advance(3.9)                    # -30 + 39 = 9 < 10
+    assert s.pop() is None
+    clk.advance(0.2)
+    assert s.pop() is not None
+
+
+def test_slot_quota_reserved_at_pop_returned_at_release():
+    s = _sched(TenantConfig("t", max_slots=2))
+    for _ in range(4):
+        s.submit(_req(tenant="t"))
+    a, b = s.pop(), s.pop()
+    assert a is not None and b is not None
+    assert s.pop() is None              # quota exhausted, queue non-empty
+    assert s.snapshot()["tenants"]["t"]["in_flight"] == 2
+    s.release(a)
+    assert s.pop() is not None
+    s.release(b)
+    s.release(b)                        # double release never goes negative
+    assert s.snapshot()["tenants"]["t"]["in_flight"] >= 0
+
+
+def test_sweep_expired_removes_heap_prefix():
+    clk = FakeClock()
+    s = TenantScheduler([TenantConfig("t", max_queue=3)], clock=clk)
+    dead1 = _req(tenant="t", deadline_s=0.5)
+    dead2 = _req(tenant="t", deadline_s=1.0)
+    alive = _req(tenant="t", deadline_s=60.0)
+    for r in (alive, dead1, dead2):
+        s.submit(r)
+    clk.advance(2.0)
+    swept = s.sweep_expired()
+    assert {r.request_id for r in swept} == {dead1.request_id,
+                                             dead2.request_id}
+    assert len(s) == 1
+    assert s.snapshot()["tenants"]["t"]["expired_total"] == 2
+    s.submit(_req(tenant="t"))          # sweep freed bounded capacity
+    s.submit(_req(tenant="t"))
+    assert s.pop().request_id == alive.request_id
+
+
+def test_drain_returns_submit_order_across_tenants():
+    s = _sched(TenantConfig("a", priority="batch"),
+               TenantConfig("b", priority="interactive"))
+    reqs = [_req(tenant=t, deadline_s=d)
+            for t, d in (("a", 9.0), ("b", None), ("a", 1.0), ("b", 2.0))]
+    for r in reqs:
+        s.submit(r)
+    drained = s.drain()
+    assert [r.request_id for r in drained] == [r.request_id for r in reqs]
+    assert len(s) == 0 and s.pop() is None
+
+
+def test_snapshot_classes_aggregate():
+    s = _sched(TenantConfig("a", priority="interactive"),
+               TenantConfig("b", priority="interactive"),
+               TenantConfig("c", priority="batch"))
+    for t in ("a", "b", "b", "c"):
+        s.submit(_req(tenant=t))
+    snap = s.snapshot()
+    assert snap["classes"]["interactive"]["queue_depth"] == 3
+    assert snap["classes"]["batch"]["queue_depth"] == 1
+    assert snap["tenants"]["b"]["queue_depth"] == 2
+
+
+# ------------------------------------------------------ engine integration
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    import jax
+    import jax.numpy as jnp
+
+    from k8s_distributed_deeplearning_tpu.models import llama
+    cfg = llama.config_tiny(dtype=jnp.float32, max_seq_len=64)
+    model = llama.LlamaLM(cfg)
+    params = model.init(jax.random.key(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    return model, params, cfg
+
+
+def _engine(tiny, **kw):
+    from k8s_distributed_deeplearning_tpu.serve import ServeEngine
+    model, params, _ = tiny
+    return ServeEngine(model, params, eos_id=None, **kw)
+
+
+def _mk(prompt_len=8, max_new=4, **kw):
+    rng = np.random.default_rng(prompt_len * 1000 + max_new)
+    return Request(prompt=rng.integers(0, 256, size=prompt_len).astype(
+        np.int32), max_new_tokens=max_new, **kw)
+
+
+def test_run_feeds_requests_as_capacity_frees(tiny):
+    """run() with a request list far larger than max_queue must complete
+    every request instead of dying on QueueFull at submit time — the
+    regression for the old upfront-submit loop."""
+    eng = _engine(tiny, num_slots=2, max_queue=2)
+    reqs = [_mk(prompt_len=6 + (i % 4), max_new=3) for i in range(12)]
+    outs = {o.request_id: o for o in eng.run(reqs)}
+    assert len(outs) == 12
+    assert all(o.finish_reason == "length" for o in outs.values())
+
+
+def test_on_finish_exactly_once_shutdown_races_expiry(tiny):
+    """A queued request whose deadline lapses just as the engine shuts
+    down gets ONE terminal callback, and a second shutdown() fires
+    nothing."""
+    eng = _engine(tiny, num_slots=2, max_queue=8)
+    calls = []
+    req = _mk(max_new=8, deadline_s=1e-9, on_finish=calls.append)
+    eng.submit(req)
+    time.sleep(0.01)                    # deadline long past before shutdown
+    aborted = eng.shutdown()
+    assert [o.finish_reason for o in aborted] == ["aborted"]
+    assert calls == ["aborted"]
+    assert eng.shutdown() == []
+    assert calls == ["aborted"]
+    # Resubmitting the same Request object re-arms the latch.
+    req.deadline_s = None
+    eng.submit(req)
+    outs = eng.run()
+    assert len(outs) == 1 and calls == ["aborted", "length"]
+
+
+def test_on_finish_exactly_once_timeout_then_shutdown(tiny):
+    """A request timed out by the queue-deadline sweep must not get a
+    second callback from a later shutdown()."""
+    eng = _engine(tiny, num_slots=2, max_queue=8)
+    calls = []
+    # Occupy both slots so the victim stays queued past its deadline.
+    blockers = [_mk(prompt_len=7, max_new=12) for _ in range(2)]
+    for b in blockers:
+        eng.submit(b)
+    eng.step()
+    victim = _mk(max_new=8, deadline_s=1e-3, on_finish=calls.append)
+    eng.submit(victim)
+    time.sleep(0.01)
+    outs = eng.step()                   # sweep fires the timeout
+    assert any(o.request_id == victim.request_id
+               and o.finish_reason == "timeout" for o in outs)
+    assert calls == ["timeout"]
+    eng.shutdown()
+    assert calls == ["timeout"]
+
+
+def test_slot_quota_under_live_victim_stream(tiny):
+    """A batch tenant capped at num_slots-1 can never occupy the whole
+    arena: its in_flight stays within quota at every step boundary while
+    an interactive stream runs alongside, and everything completes."""
+    cfgs = [TenantConfig("chat", priority="interactive"),
+            TenantConfig("bulk", priority="batch", max_slots=2)]
+    eng = _engine(tiny, num_slots=3, max_queue=64, tenants=cfgs)
+    reqs = ([_mk(prompt_len=6 + (i % 3), max_new=6, tenant="bulk")
+             for i in range(8)]
+            + [_mk(prompt_len=5, max_new=3, tenant="chat")
+               for _ in range(3)])
+    for r in reqs:
+        eng.submit(r)
+    done = []
+    while eng.busy():
+        done.extend(eng.step())
+        assert eng.queue.snapshot()["tenants"]["bulk"]["in_flight"] <= 2
+    assert len(done) == len(reqs)
+    snap = eng.queue.snapshot()["tenants"]
+    assert snap["bulk"]["popped_total"] == 8
+    assert snap["chat"]["popped_total"] == 3
+    assert snap["chat"]["in_flight"] == snap["bulk"]["in_flight"] == 0
+
+
+def test_overload_chaos_matrix_interactive_isolated(tiny):
+    """Chaos overload: decode iterations stalled via the fault harness
+    while a batch tenant floods a bounded queue. The interactive tenant
+    must keep its queue waits below the batch tenant's and shed nothing —
+    the SLO-isolation acceptance check, driven end to end through
+    activate()/fire()."""
+    from k8s_distributed_deeplearning_tpu import faults
+    cfgs = [TenantConfig("chat", priority="interactive"),
+            TenantConfig("bulk", priority="batch", max_slots=1,
+                         max_queue=4)]
+    eng = _engine(tiny, num_slots=2, max_queue=64, tenants=cfgs)
+    plan = faults.FaultPlan((faults.Fault(site="serve_decode",
+                                          action="stall", seconds=0.02,
+                                          count=6),))
+    faults.activate(plan, rank=0, attempt=0)
+    try:
+        shed = 0
+        outs = []
+        feed = ([_mk(prompt_len=8, max_new=6, tenant="bulk")
+                 for _ in range(10)]
+                + [_mk(prompt_len=4, max_new=2, tenant="chat")
+                   for _ in range(4)])
+        pending = list(feed)
+        while pending or eng.busy():
+            still = []
+            for r in pending:
+                try:
+                    eng.submit(r)
+                except QueueFull as e:
+                    assert "bulk" in str(e)
+                    shed += 1
+                    still.append(r)
+            pending = still
+            outs.extend(eng.step())
+        assert len(outs) == len(feed)
+        snap = eng.queue.snapshot()["tenants"]
+        assert snap["chat"]["shed_total"] == 0
+        assert snap["bulk"]["shed_total"] == shed > 0
+        by_id = {o.request_id: o for o in outs}
+        chat_w = [by_id[r.request_id].queue_s for r in feed
+                  if r.tenant == "chat"]
+        bulk_w = [by_id[r.request_id].queue_s for r in feed
+                  if r.tenant == "bulk"]
+        assert max(chat_w) < max(bulk_w)
+    finally:
+        faults.deactivate()
