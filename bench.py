@@ -1007,6 +1007,86 @@ def measure_telemetry_overhead(steps: int = 30, warmup: int = 5,
     }
 
 
+def measure_request_trace_overhead(n_requests: int = 8, num_slots: int = 4,
+                                   out_len: int = 48, repeats: int = 10,
+                                   seed: int = 0) -> dict:
+    """Request-lifecycle-trace overhead: the serve engine with
+    ``request_trace_sample=1.0`` (every finished request emits one
+    request_trace JSONL event to a null sink — the worst-case sampling
+    rate, serialization included) vs sampling off. The measured delta is
+    the crc32 hash + event build on the terminal path, amortized over
+    the run's decode steps; the telemetry-suite gate asserts < 2%.
+    The true per-step cost is sub-microsecond (n_requests emits across
+    ~n_requests*out_len/num_slots decode steps), an order of magnitude
+    below shared-box load swings, so the estimator must be drift-proof:
+    each repeat runs the two modes back-to-back (order alternating) and
+    the reported overhead is the MEDIAN of the paired ratios. Pairs
+    share temporally local machine conditions, so block-scale neighbor
+    drift cancels inside each pair — a min-of-mins across the whole run
+    does not have that property and was observed billing 2-4% of pure
+    load shift to whichever mode drew the louder minutes."""
+    import os as _os
+
+    import numpy as np
+
+    from k8s_distributed_deeplearning_tpu.serve import Request, ServeEngine
+    from k8s_distributed_deeplearning_tpu.utils.metrics import MetricsLogger
+
+    max_seq = 256
+    model, params, cfg, _ = _serve_cpu_model(max_seq)
+    rng = np.random.default_rng(seed)
+    prompts = [rng.integers(0, cfg.vocab_size, size=int(
+        rng.integers(32, 128))).astype(np.int32) for _ in range(n_requests)]
+
+    sink = open(_os.devnull, "w")
+    try:
+        null_logger = MetricsLogger(stream=sink, job="bench")
+
+        def run(traced: bool) -> tuple[float, int]:
+            eng = ServeEngine(
+                model, params, num_slots=num_slots, max_queue=n_requests,
+                request_trace_sample=1.0 if traced else 0.0,
+                request_log=null_logger if traced else None)
+            reqs = [Request(prompt=p, max_new_tokens=out_len)
+                    for p in prompts]
+            t0 = time.perf_counter()
+            eng.run(reqs)
+            dt = (time.perf_counter() - t0) / max(eng.stats.steps, 1)
+            return dt, eng.stats.request_traces
+
+        run(False)                             # warmup replays (compiles)
+        run(True)
+        times = {False: float("inf"), True: float("inf")}
+        pcts = []
+        traces = 0
+        for i in range(repeats):
+            # Alternate which mode runs first inside each pair: a
+            # monotonic machine-load drift otherwise systematically bills
+            # whichever mode always goes second.
+            pair = {}
+            for mode in ((False, True) if i % 2 == 0 else (True, False)):
+                dt, n = run(mode)
+                pair[mode] = dt
+                times[mode] = min(times[mode], dt)
+                if mode:
+                    traces = n
+            pcts.append((pair[True] - pair[False]) / pair[False] * 100.0)
+    finally:
+        sink.close()
+    pcts.sort()
+    mid = len(pcts) // 2
+    pct = (pcts[mid] if len(pcts) % 2 else (pcts[mid - 1] + pcts[mid]) / 2)
+    return {
+        "request_trace_overhead_pct": round(pct, 3),
+        "request_trace_paired_pcts": [round(p, 2) for p in pcts],
+        "serve_step_ms_untraced": round(times[False] * 1e3, 4),
+        "serve_step_ms_traced": round(times[True] * 1e3, 4),
+        "request_traces_last_window": traces,
+        "request_trace_config": {"requests": n_requests, "slots": num_slots,
+                                 "out_len": out_len, "repeats": repeats},
+    }
+
+
 _RECOVERY_WORKER = '''\
 """Recovery-bench worker: tiny train run that logs wall-clock step events
 to a shared file, so the parent can time kill -> first post-restore step
@@ -1334,12 +1414,20 @@ def main() -> None:
     if args.suite == "telemetry":
         extra = measure_telemetry_overhead(steps=args.steps,
                                            warmup=args.warmup)
+        extra.update(measure_request_trace_overhead())
         emit({
             "metric": "telemetry_overhead_pct",
             "value": extra["telemetry_overhead_pct"],
             "unit": "% of mean step time (tracing on vs off)",
             "vs_baseline": None,
             "extra": extra})
+        # Absolute gate, independent of the stored baseline: full-rate
+        # request-lifecycle sampling must cost < 2% of serve step time.
+        if extra["request_trace_overhead_pct"] >= 2.0:
+            print("GATE request_trace_overhead_pct: "
+                  f"{extra['request_trace_overhead_pct']} >= 2.0",
+                  file=sys.stderr)
+            sys.exit(2)
         return
     if args.suite == "recovery":
         extra = measure_recovery()

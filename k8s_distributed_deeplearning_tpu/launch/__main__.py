@@ -83,6 +83,11 @@ def main(argv: list[str] | None = None) -> int:
     parsers["watch"].add_argument(
         "--heartbeat-stale-after", type=float, default=120.0,
         help="seconds without a heartbeat before a rank counts as stalled")
+    parsers["watch"].add_argument(
+        "--straggler-lag-steps", type=int, default=None,
+        help="report a live rank whose heartbeat step trails the gang's "
+             "max by more than this many steps (requires --heartbeat-dir; "
+             "default: off)")
     parsers["run-local"].add_argument("--timeout", type=int, default=600)
     parsers["run-local"].add_argument(
         "--max-restarts", type=int, default=0,
@@ -126,6 +131,7 @@ def main(argv: list[str] | None = None) -> int:
                 apply_first=args.apply_first,
                 heartbeat_dir=args.heartbeat_dir,
                 heartbeat_stale_after=args.heartbeat_stale_after,
+                straggler_lag_steps=args.straggler_lag_steps,
                 on_event=lambda m: print(f"watch: {m}", file=sys.stderr))
         except (RuntimeError, ValueError) as e:
             print(f"watch failed: {e}", file=sys.stderr)

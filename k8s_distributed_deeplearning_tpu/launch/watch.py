@@ -206,6 +206,7 @@ def watch(cfg: JobConfig, *,
           heartbeat_dir: str | None = None,
           heartbeat_stale_after: float = 120.0,
           heartbeat_clock: Callable[[], float] = time.time,
+          straggler_lag_steps: int | None = None,
           checkpoint_dir: str | None = None,
           min_progress_steps: int = 1,
           crash_loop_after: int = 3) -> WatchResult:
@@ -231,6 +232,17 @@ def watch(cfg: JobConfig, *,
     an anonymous attempt timeout half an hour later. Ranks are re-reported
     only after recovering (fresh heartbeat) and stalling again.
 
+    *straggler_lag_steps* (requires *heartbeat_dir*): additionally compare
+    LIVE ranks' heartbeat steps each poll — a rank whose reported step
+    trails the gang's maximum by more than this many steps is reported as
+    a straggler with its lag and last-completed span (graftscope's
+    attribution, online and approximate: the span names WHERE the slow
+    rank spends time; run ``graftscope steps`` on the rank logs for the
+    per-step breakdown). Episodic like stall reports: a rank is
+    re-reported only after catching back up and lagging again. Note the
+    difference from stall detection: a straggler still beats (it is slow,
+    not wedged), so the stale-file check never sees it.
+
     *checkpoint_dir*: enables crash-loop detection over the shared
     checkpoint volume (same contract as ``run_elastic``): a reconcile
     whose attempt advanced the newest on-disk step by fewer than
@@ -243,6 +255,7 @@ def watch(cfg: JobConfig, *,
     emit = on_event or (lambda _msg: None)
     restarts = 0
     stalled_ranks: set[int] = set()     # currently-reported stalls
+    lagging_ranks: set[int] = set()     # currently-reported stragglers
     no_progress = 0
     loop_statuses: list[str] = []
     last_ckpt_step = (latest_step_on_disk(checkpoint_dir)
@@ -263,6 +276,29 @@ def watch(cfg: JobConfig, *,
         stalled_ranks.clear()
         stalled_ranks.update(current)
 
+    def check_stragglers() -> None:
+        if heartbeat_dir is None or straggler_lag_steps is None:
+            return
+        recs = {int(r["rank"]): r for r in hb.read_heartbeats(heartbeat_dir)
+                if "step" in r}
+        if len(recs) < 2:
+            return          # "behind" needs a peer to be behind OF
+        lead = max(int(r["step"]) for r in recs.values())
+        current = set()
+        for rank, rec in sorted(recs.items()):
+            lag = lead - int(rec["step"])
+            if lag > straggler_lag_steps:
+                current.add(rank)
+                if rank not in lagging_ranks:
+                    emit(f"rank {rank} straggling: {lag} steps behind the "
+                         f"gang (step {rec['step']} vs {lead}, last "
+                         f"completed span: "
+                         f"{rec.get('last_span') or 'unknown'})")
+        for rank in sorted(lagging_ranks - current):
+            emit(f"rank {rank} caught up")
+        lagging_ranks.clear()
+        lagging_ranks.update(current)
+
     def apply_current(c: JobConfig) -> None:
         docs = render.render_all(c)
         validate.validate_or_raise(docs)
@@ -279,6 +315,7 @@ def watch(cfg: JobConfig, *,
         while clock() < deadline:
             status = kubectl.job_status(cfg)
             check_heartbeats()
+            check_stragglers()
             if status.complete(cfg):
                 emit(f"complete: {status.succeeded}/{cfg.num_workers} "
                      "succeeded")

@@ -68,6 +68,19 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--trace", action="store_true",
                     help="emit span events (prefill/decode/admission) "
                          "through the JSONL stream")
+    ap.add_argument("--request-trace-sample", type=float, default=0.0,
+                    metavar="FRAC",
+                    help="emit one request_trace lifecycle event (submit→"
+                         "queue→prefill→decode→finish) for this fraction "
+                         "of finished requests, sampled deterministically "
+                         "by request id (0 = off, 1 = every request); "
+                         "analyze with `graftscope requests`")
+    ap.add_argument("--debug-dir", default=None, metavar="DIR",
+                    help="enable the exporter's on-demand debug surface "
+                         "(requires --metrics-port): /debug/spans serves "
+                         "an in-memory ring of recent spans, "
+                         "/debug/profile?ms=N captures a windowed "
+                         "jax.profiler trace into DIR")
     args = ap.parse_args(argv)
 
     # Flag validation BEFORE the heavy imports/model build: a bad flag
@@ -86,6 +99,12 @@ def main(argv: list[str] | None = None) -> int:
     if args.shared_prefix_len < 0:
         ap.error(f"--shared-prefix-len must be >= 0, got "
                  f"{args.shared_prefix_len}")
+    if not 0.0 <= args.request_trace_sample <= 1.0:
+        ap.error(f"--request-trace-sample must be in [0, 1], got "
+                 f"{args.request_trace_sample}")
+    if args.debug_dir is not None and args.metrics_port is None:
+        ap.error("--debug-dir requires --metrics-port (the debug surface "
+                 "rides the metrics exporter)")
 
     import jax
     import jax.numpy as jnp
@@ -128,15 +147,21 @@ def main(argv: list[str] | None = None) -> int:
                               top_k=args.top_k, top_p=args.top_p)
     logger = MetricsLogger(job="serve", path=args.metrics_path)
     tracer = None
-    if args.trace:
+    if args.trace or args.debug_dir is not None:
         from k8s_distributed_deeplearning_tpu.telemetry.trace import Tracer
-        tracer = Tracer(logger)
+        # --debug-dir without --trace: a record-only tracer (no logger)
+        # still fills the ring buffer behind /debug/spans without putting
+        # span events on the JSONL stream.
+        tracer = Tracer(logger if args.trace else None,
+                        ring_size=512 if args.debug_dir is not None else 0)
     engine = ServeEngine(
         model, params, num_slots=args.slots,
         max_queue=args.max_queue or args.requests,
         eos_id=args.eos_id, tracer=tracer, tenants=tenant_cfgs,
         prefill_chunk_tokens=args.prefill_chunk_tokens or None,
-        prefix_cache_mb=args.prefix_cache_mb or None)
+        prefix_cache_mb=args.prefix_cache_mb or None,
+        request_trace_sample=args.request_trace_sample,
+        request_log=logger)
     exporter = None
     if args.metrics_port is not None:
         from k8s_distributed_deeplearning_tpu.telemetry import bridge
@@ -147,7 +172,10 @@ def main(argv: list[str] | None = None) -> int:
         registry = MetricsRegistry()
         bridge.serving_collector(registry, engine.stats)
         bridge.sched_collector(registry, engine.queue)
-        exporter = MetricsExporter(registry, port=args.metrics_port).start()
+        exporter = MetricsExporter(
+            registry, port=args.metrics_port,
+            tracer=tracer if args.debug_dir is not None else None,
+            profile_dir=args.debug_dir).start()
     shared = rng.integers(0, cfg.vocab_size, size=args.shared_prefix_len)
     tenant_ids = engine.queue.tenant_ids()
     from collections import deque

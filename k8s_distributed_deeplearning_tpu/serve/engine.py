@@ -61,6 +61,7 @@ from __future__ import annotations
 
 import functools
 import time
+import zlib
 from collections import deque
 from typing import Any, Iterable
 
@@ -233,7 +234,7 @@ class _InFlight:
     """Host-side record for the request occupying a slot."""
 
     __slots__ = ("req", "tokens", "t_submit", "t_admit", "t_first",
-                 "cached_prompt_tokens")
+                 "cached_prompt_tokens", "prefill_chunks")
 
     def __init__(self, req: Request, first_token: int, t_admit: float):
         self.req = req
@@ -242,6 +243,7 @@ class _InFlight:
         self.t_admit = t_admit
         self.t_first = t_admit
         self.cached_prompt_tokens = 0
+        self.prefill_chunks = 0
 
 
 class _PendingPrefill:
@@ -252,7 +254,7 @@ class _PendingPrefill:
     backing the pasted region until the splice lands."""
 
     __slots__ = ("req", "prompt", "n", "cache", "pos", "hit_tokens",
-                 "nodes", "t_pop")
+                 "nodes", "t_pop", "chunks")
 
     def __init__(self, req: Request, prompt: np.ndarray, cache: PyTree,
                  pos: int, hit_tokens: int, nodes: list, t_pop: float):
@@ -264,6 +266,7 @@ class _PendingPrefill:
         self.hit_tokens = hit_tokens
         self.nodes = nodes
         self.t_pop = t_pop
+        self.chunks = 0        # compiled prefill program runs so far
 
 
 class ServeEngine:
@@ -304,7 +307,9 @@ class ServeEngine:
                  prefix_block_tokens: int | None = None,
                  tenants: Iterable[TenantConfig] | None = None,
                  stats: ServingStats | None = None,
-                 tracer: Tracer | None = None):
+                 tracer: Tracer | None = None,
+                 request_trace_sample: float = 0.0,
+                 request_log: "Any | None" = None):
         if num_slots < 2:
             raise ValueError(f"num_slots must be >= 2, got {num_slots}")
         cfg = getattr(model, "cfg", None)
@@ -324,6 +329,10 @@ class ServeEngine:
             raise ValueError(
                 f"prefix_cache_mb must be >= 0 (0 = off), got "
                 f"{prefix_cache_mb}")
+        if not 0.0 <= request_trace_sample <= 1.0:
+            raise ValueError(
+                f"request_trace_sample must be in [0, 1], got "
+                f"{request_trace_sample}")
         self.model = model
         self.params = params
         self.num_slots = num_slots
@@ -338,6 +347,14 @@ class ServeEngine:
         # chunk + splice) and "decode" (one arena-wide decode iteration
         # incl. the host sync).
         self.tracer = tracer if tracer is not None else _NULL_TRACER
+        # End-to-end lifecycle traces (graftscope): each terminal path
+        # funnels through _emit_request_trace, which emits one sampled
+        # ``request_trace`` JSONL event per finished request. Sampling is
+        # a pure function of request_id (crc32), so "did request X get
+        # traced" is reproducible across ranks and restarts — no RNG.
+        self.request_trace_sample = float(request_trace_sample)
+        self.request_log = (request_log if request_log is not None
+                            else self.tracer.logger)
         self.queue = TenantScheduler(tenants, default_max_queue=max_queue)
         # Per-slot register file (host numpy; fixed dtypes so the decode
         # program's operand signature — and thus its compilation — never
@@ -555,10 +572,12 @@ class ServeEngine:
         now = time.perf_counter()
         for req in self.queue.drain():
             t0 = req._t_submit if req._t_submit is not None else now
-            outs.append(RequestOutput(
+            out = RequestOutput(
                 request_id=req.request_id, prompt_len=len(req.prompt),
                 tokens=[], finish_reason="aborted", queue_s=now - t0,
-                ttft_s=None, latency_s=now - t0))
+                ttft_s=None, latency_s=now - t0)
+            outs.append(out)
+            self._emit_request_trace(req, out)
             self._notify_finish(req, "aborted")
         for slot in list(self._pending):
             outs.append(self._cancel_pending(slot, "aborted"))
@@ -604,8 +623,7 @@ class ServeEngine:
         if req.on_finish is not None:
             req.on_finish(reason)
 
-    @staticmethod
-    def _timeout_unadmitted(req: Request) -> RequestOutput:
+    def _timeout_unadmitted(self, req: Request) -> RequestOutput:
         """Terminal output for a request whose deadline expired while it
         was still queued — no slot, no tokens, no prefill spent on it."""
         now = time.perf_counter()
@@ -614,8 +632,50 @@ class ServeEngine:
             request_id=req.request_id, prompt_len=len(req.prompt),
             tokens=[], finish_reason="timeout", queue_s=now - t0,
             ttft_s=None, latency_s=now - t0)
-        ServeEngine._notify_finish(req, "timeout")
+        self._emit_request_trace(req, out)
+        self._notify_finish(req, "timeout")
         return out
+
+    def _sampled(self, request_id: str) -> bool:
+        """Deterministic per-request sampling decision: a pure hash of the
+        request id, so the same request traces (or doesn't) on every
+        replica and rerun — correlatable across logs, and testable."""
+        s = self.request_trace_sample
+        if s <= 0.0 or self.request_log is None:
+            return False
+        if s >= 1.0:
+            return True
+        return zlib.crc32(request_id.encode()) < s * 2 ** 32
+
+    def _emit_request_trace(self, req: Request, out: RequestOutput) -> None:
+        """The lifecycle funnel: every terminal path (_finish,
+        _cancel_pending, _timeout_unadmitted, shutdown's queued drain)
+        lands here with the finished RequestOutput; sampled requests emit
+        one ``request_trace`` JSONL event tying the whole journey —
+        submit → queue → prefill chunks → decode → finish — to the
+        request_id."""
+        if not self._sampled(out.request_id):
+            return
+        n = len(out.tokens)
+        priority = getattr(self.queue, "priority_of", None)
+        self.request_log.emit(
+            "request_trace",
+            request_id=out.request_id,
+            tenant=req.tenant,
+            priority=priority(req.tenant) if priority is not None else None,
+            prompt_len=out.prompt_len,
+            cached_prompt_tokens=out.cached_prompt_tokens,
+            prefill_chunks=out.prefill_chunks,
+            queue_ms=round(out.queue_s * 1e3, 3),
+            ttft_ms=(round(out.ttft_s * 1e3, 3)
+                     if out.ttft_s is not None else None),
+            latency_ms=round(out.latency_s * 1e3, 3),
+            new_tokens=n,
+            decode_steps=max(0, n - 1),
+            tokens_per_s=(round(n / out.latency_s, 1)
+                          if n and out.latency_s > 0 else None),
+            finish_reason=out.finish_reason)
+        self.stats.record_request_trace()
 
     def _bucket(self, n: int) -> int:
         b = self.min_bucket
@@ -683,6 +743,7 @@ class ServeEngine:
                             self.model, self.params, pend.cache,
                             np.ascontiguousarray(chunk))
                     pend.pos += c
+                    pend.chunks += 1
                     self._charge_prefill(c)
                     continue
                 if budget is not None and rem > budget:
@@ -755,6 +816,7 @@ class ServeEngine:
         fl = _InFlight(req, first, now)
         fl.t_admit = pend.t_pop
         fl.cached_prompt_tokens = pend.hit_tokens
+        fl.prefill_chunks = pend.chunks + 1     # + the final sampling chunk
         self._slots[slot] = fl
         self._tokens[slot] = first
         self._kv_lens[slot] = n          # next write position
@@ -784,10 +846,12 @@ class ServeEngine:
             request_id=pend.req.request_id, prompt_len=pend.n,
             tokens=[], finish_reason=reason, queue_s=pend.t_pop - t0,
             ttft_s=None, latency_s=now - t0,
-            cached_prompt_tokens=pend.hit_tokens)
+            cached_prompt_tokens=pend.hit_tokens,
+            prefill_chunks=pend.chunks)
         self.stats.record_completion(latency_s=out.latency_s, n_tokens=0,
                                      reason=reason)
         self.queue.release(pend.req)
+        self._emit_request_trace(pend.req, out)
         self._notify_finish(pend.req, reason)
         return out
 
@@ -800,7 +864,8 @@ class ServeEngine:
             queue_s=fl.t_admit - fl.t_submit,
             ttft_s=fl.t_first - fl.t_submit,
             latency_s=now - fl.t_submit,
-            cached_prompt_tokens=fl.cached_prompt_tokens)
+            cached_prompt_tokens=fl.cached_prompt_tokens,
+            prefill_chunks=fl.prefill_chunks)
         self._slots[slot] = None
         self._tokens[slot] = self.pad_id
         self._kv_lens[slot] = 0
@@ -810,5 +875,6 @@ class ServeEngine:
         self.stats.record_completion(latency_s=out.latency_s,
                                      n_tokens=len(out.tokens), reason=reason)
         self.queue.release(fl.req)
+        self._emit_request_trace(fl.req, out)
         self._notify_finish(fl.req, reason)
         return out

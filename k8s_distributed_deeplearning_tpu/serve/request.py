@@ -95,7 +95,10 @@ class RequestOutput:
     none). ``ttft_s`` is None for requests aborted/timed out before
     their first token. ``cached_prompt_tokens`` counts the prompt tokens
     served from the engine's prefix-reuse KV cache instead of being
-    prefilled (0 when the cache is off or missed).
+    prefilled (0 when the cache is off or missed). ``prefill_chunks``
+    counts compiled prefill program runs spent on this request's prompt
+    (intermediate chunks + the final sampling chunk; 0 for requests that
+    never started prefilling).
     """
 
     request_id: str
@@ -106,3 +109,4 @@ class RequestOutput:
     ttft_s: float | None
     latency_s: float
     cached_prompt_tokens: int = 0
+    prefill_chunks: int = 0

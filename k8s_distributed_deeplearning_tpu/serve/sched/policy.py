@@ -296,6 +296,13 @@ class TenantScheduler:
     def tenant_ids(self) -> tuple[str, ...]:
         return tuple(self._tenants)
 
+    def priority_of(self, tenant_id: str | None) -> str | None:
+        """The priority class a tenant's requests run under (None for an
+        unregistered tenant) — stamped onto ``request_trace`` events so
+        lifecycle traces group by class, not just tenant."""
+        ts = self._tenants.get(tenant_id or DEFAULT_TENANT)
+        return ts.cfg.priority if ts is not None else None
+
     def snapshot(self) -> dict:
         """Point-in-time view for the Prometheus collector and the CLI's
         ``sched_tenant_summary`` events: per-tenant depth/shed/quota state

@@ -132,6 +132,9 @@ def serving_collector(registry: MetricsRegistry,
         "serve_prefix_hit_rate": registry.gauge(
             "serve_prefix_hit_rate",
             "fraction of looked-up prompt tokens served from cached KV"),
+        "serve_request_traces_sampled": registry.gauge(
+            "serve_request_traces_sampled",
+            "request_trace lifecycle events emitted (graftscope sampling)"),
     }
     key_map = {"requests_admitted": "serve_requests_admitted",
                "requests_completed": "serve_requests_completed",
@@ -146,7 +149,8 @@ def serving_collector(registry: MetricsRegistry,
                "prefix_cache_hits": "serve_prefix_cache_hits",
                "prefix_cache_misses": "serve_prefix_cache_misses",
                "prefix_cache_evictions": "serve_prefix_cache_evictions",
-               "prefix_hit_rate": "serve_prefix_hit_rate"}
+               "prefix_hit_rate": "serve_prefix_hit_rate",
+               "request_traces_sampled": "serve_request_traces_sampled"}
 
     def collect() -> None:
         summ = stats.summary()

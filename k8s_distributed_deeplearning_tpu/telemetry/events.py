@@ -26,7 +26,11 @@ EVENTS: dict[str, str] = {
     "preempted": "SIGTERM consensus reached; checkpointed and exiting",
     "serve_request": "one serving request completed: tokens, TTFT, latency",
     "serve_summary": "end-of-run serving aggregate: tokens/sec, percentiles",
-    "span": "a traced span closed: name, dur_ms, depth, parent, rank",
+    "span": "a traced span closed: name, dur_ms, depth, parent, rank, "
+            "thread",
+    "request_trace": "sampled end-to-end request lifecycle: queue wait, "
+                     "prefill chunks, TTFT, decode steps, tokens/s, "
+                     "finish reason (graftscope requests)",
     # graftlint: disable=event-registry — heartbeat/stall are written by
     # the heartbeat file plane and `launch watch`, not via .emit().
     "heartbeat": "per-rank liveness record (also written as heartbeat files)",

@@ -9,11 +9,26 @@ daemon thread: scrapes never block a train step, and the process never
 waits on the exporter to exit.
 
 ``port=0`` binds an ephemeral port (tests; ``.port`` reports the choice).
+
+Opt-in debug surface (graftscope's capture hooks — both 404 unless the
+owning process wired them in):
+
+- ``/debug/spans`` — JSON dump of the tracer's in-memory span ring
+  buffer. Readable with a bare curl when the Loki pipeline itself is the
+  thing that's down.
+- ``/debug/profile?ms=N`` — capture a windowed ``jax.profiler`` trace of
+  whatever the process is doing for the next N ms and report the output
+  directory. One capture at a time (concurrent requests get a 409); the
+  window runs on the scrape's handler thread so the train/serve loop
+  never blocks on it.
 """
 from __future__ import annotations
 
 import json
+import os
 import threading
+import time
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable
 
@@ -29,24 +44,52 @@ class MetricsExporter:
     *healthz* is an optional zero-arg callable returning extra fields for
     the ``/healthz`` JSON body (e.g. heartbeat ages); a raising callable
     turns the probe into a 503 — wire real liveness conditions there.
+
+    *tracer* (a :class:`telemetry.trace.Tracer` built with ``ring_size``)
+    enables ``/debug/spans``; *profile_dir* enables ``/debug/profile``.
+    *profiler* overrides the capture context manager (default:
+    ``utils.profiling.trace``, imported lazily so a metrics-only process
+    never pays the jax import) — tests inject a fake here.
     """
 
     def __init__(self, registry: MetricsRegistry, *, host: str = "0.0.0.0",
                  port: int = 9090,
-                 healthz: Callable[[], dict] | None = None):
+                 healthz: Callable[[], dict] | None = None,
+                 tracer=None, profile_dir: str | None = None,
+                 profiler: Callable | None = None):
         self.registry = registry
         self.healthz = healthz
+        self.tracer = tracer
+        self.profile_dir = profile_dir
+        self._profiler = profiler
+        self._profile_lock = threading.Lock()
+        self._profile_seq = 0
         self._server = ThreadingHTTPServer((host, port), self._handler())
         self._server.daemon_threads = True
         self.port = self._server.server_address[1]
         self._thread: threading.Thread | None = None
+
+    def _capture_profile(self, ms: int) -> str:
+        """Run one windowed profiler capture; returns the trace dir.
+        Caller must hold ``_profile_lock``."""
+        self._profile_seq += 1
+        out = os.path.join(self.profile_dir,
+                           f"ondemand-{self._profile_seq:04d}")
+        profiler = self._profiler
+        if profiler is None:
+            from k8s_distributed_deeplearning_tpu.utils.profiling import (
+                trace)
+            profiler = trace
+        with profiler(out):
+            time.sleep(ms / 1e3)
+        return out
 
     def _handler(self):
         exporter = self
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):
-                path = self.path.split("?", 1)[0]
+                path, _, query = self.path.partition("?")
                 if path == "/metrics":
                     body = exporter.registry.render().encode()
                     self._reply(200, CONTENT_TYPE, body)
@@ -59,15 +102,72 @@ class MetricsExporter:
                         body = json.dumps({"ok": False,
                                            "error": repr(e)}).encode()
                         self._reply(503, "application/json", body)
+                elif path == "/debug/spans":
+                    self._debug_spans()
+                elif path == "/debug/profile":
+                    self._debug_profile(query)
                 else:
                     self._reply(404, "text/plain", b"not found\n")
 
+            def _debug_spans(self) -> None:
+                if exporter.tracer is None:
+                    self._reply(404, "application/json", json.dumps(
+                        {"error": "no span ring buffer configured "
+                                  "(pass tracer= to MetricsExporter)"}
+                        ).encode())
+                    return
+                spans = exporter.tracer.recent_spans()
+                body = json.dumps({"spans": spans,
+                                   "count": len(spans)}).encode()
+                self._reply(200, "application/json", body)
+
+            def _debug_profile(self, query: str) -> None:
+                if exporter.profile_dir is None:
+                    self._reply(404, "application/json", json.dumps(
+                        {"error": "profiling not configured (pass "
+                                  "profile_dir= to MetricsExporter)"}
+                        ).encode())
+                    return
+                try:
+                    params = urllib.parse.parse_qs(query)
+                    ms = int(params.get("ms", ["500"])[0])
+                except ValueError:
+                    self._reply(400, "application/json", json.dumps(
+                        {"error": "ms must be an integer"}).encode())
+                    return
+                # Clamp: a zero/negative window is a no-op request, a huge
+                # one would pin the handler thread (and the profiler's
+                # buffers) for minutes.
+                ms = max(1, min(ms, 60_000))
+                if not exporter._profile_lock.acquire(blocking=False):
+                    self._reply(409, "application/json", json.dumps(
+                        {"error": "a profile capture is already running"}
+                        ).encode())
+                    return
+                try:
+                    out = exporter._capture_profile(ms)
+                except Exception as e:   # profiler failure → 500, not a
+                    self._reply(500, "application/json", json.dumps(  # crash
+                        {"ok": False, "error": repr(e)}).encode())
+                    return
+                finally:
+                    exporter._profile_lock.release()
+                self._reply(200, "application/json", json.dumps(
+                    {"ok": True, "trace_dir": out, "ms": ms}).encode())
+
             def _reply(self, code: int, ctype: str, body: bytes) -> None:
-                self.send_response(code)
-                self.send_header("Content-Type", ctype)
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
+                # A scraper that hangs up mid-response (timeout, pod kill)
+                # half-closes the socket; without the catch every such
+                # scrape stack-traces in the handler thread and spams
+                # stderr — which on a worker pod is the JSONL log stream.
+                try:
+                    self.send_response(code)
+                    self.send_header("Content-Type", ctype)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                except (BrokenPipeError, ConnectionResetError):
+                    self.close_connection = True
 
             def log_message(self, *args) -> None:
                 pass    # scrapes must not spam the JSONL stdout stream

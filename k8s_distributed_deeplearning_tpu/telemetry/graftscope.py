@@ -1,0 +1,168 @@
+"""graftscope CLI: analyze per-rank span/event JSONL offline.
+
+The workflow the README documents::
+
+    # capture: run with --trace --metrics-path (or scrape /debug/spans),
+    # one JSONL file per rank
+    graftscope steps rank0.jsonl rank1.jsonl ...   # straggler attribution
+    graftscope requests serve.jsonl                # request lifecycles
+    graftscope export-perfetto *.jsonl -o trace.json   # → ui.perfetto.dev
+
+Stdlib-only (no jax): runs on a laptop against scp'd logs. All the
+analysis lives in :mod:`telemetry.timeline`; this module is formatting.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from k8s_distributed_deeplearning_tpu.telemetry import timeline
+
+
+def _fmt_ms(v: float | None) -> str:
+    return "-" if v is None else f"{v:9.2f}"
+
+
+def _cmd_steps(args: argparse.Namespace) -> int:
+    parsed = timeline.parse_files(args.logs)
+    if parsed.skipped:
+        print(f"note: skipped {parsed.skipped} unparseable line(s) "
+              f"of {parsed.total_lines} (torn writes from killed ranks?)",
+              file=sys.stderr)
+    timelines = timeline.build_step_timelines(parsed)
+    attrs = timeline.attribute_stragglers(timelines)
+    summary = timeline.straggler_summary(
+        attrs, threshold_ms=args.threshold_ms, ratio=args.ratio)
+    path = timeline.critical_path(timelines)
+    if args.json:
+        json.dump({"steps": len(timelines), "ranks": parsed.ranks(),
+                   "skipped_lines": parsed.skipped,
+                   "critical_path_ms": path, "stragglers": summary,
+                   "attributions": [vars(a) for a in attrs]},
+                  sys.stdout, indent=2, default=str)
+        print()
+        return 0
+    if not timelines:
+        print("no step-stamped spans found — was tracing enabled "
+              "(--trace), and do spans carry step= fields?")
+        return 1
+    print(f"{len(timelines)} steps across ranks {parsed.ranks()}")
+    print("\ncritical path (slowest rank per step, summed):")
+    total = sum(path.values()) or 1.0
+    for name, ms in path.items():
+        print(f"  {name:<12} {ms:10.1f} ms  {100 * ms / total:5.1f}%")
+    print(f"\nstraggler steps (wall > {args.ratio}x median "
+          f"+ {args.threshold_ms} ms): "
+          f"{summary['straggler_steps']}/{summary['steps_analyzed']}")
+    for culprit, n in summary["culprits"].items():
+        print(f"  {culprit:<24} {n} step(s)")
+    if summary["worst"]:
+        w = summary["worst"]
+        print(f"  worst: step {w['step']} — rank {w['rank']} "
+              f"+{w['lag_ms']:.1f} ms in {w['span']}")
+    if args.verbose:
+        print("\nper-step attribution (slowest rank vs median):")
+        print(f"  {'step':>6} {'rank':>4} {'wall_ms':>9} {'median':>9} "
+              f"{'lag':>9}  span")
+        for a in attrs:
+            print(f"  {a.step:>6} {a.slowest_rank:>4} "
+                  f"{_fmt_ms(a.wall_ms)} {_fmt_ms(a.median_wall_ms)} "
+                  f"{_fmt_ms(a.lag_ms)}  {a.span} "
+                  f"(+{a.span_excess_ms:.1f} ms)")
+    return 0
+
+
+def _cmd_requests(args: argparse.Namespace) -> int:
+    parsed = timeline.parse_files(args.logs)
+    if parsed.skipped:
+        print(f"note: skipped {parsed.skipped} unparseable line(s)",
+              file=sys.stderr)
+    summary = timeline.requests_summary(parsed)
+    if args.json:
+        json.dump(summary, sys.stdout, indent=2)
+        print()
+        return 0
+    if not summary["requests"]:
+        print("no request_trace events found — was the engine run with "
+              "request_trace_sample > 0?")
+        return 1
+    print(f"{summary['requests']} sampled request trace(s)")
+    for tenant, t in summary["tenants"].items():
+        print(f"\ntenant {tenant} ({t['requests']} requests):")
+        print(f"  queue   p50 {_fmt_ms(t['queue_p50_ms'])} ms   "
+              f"p95 {_fmt_ms(t['queue_p95_ms'])} ms")
+        print(f"  ttft    p50 {_fmt_ms(t['ttft_p50_ms'])} ms   "
+              f"p95 {_fmt_ms(t['ttft_p95_ms'])} ms")
+        print(f"  latency p95 {_fmt_ms(t['latency_p95_ms'])} ms   "
+              f"tokens/s p50 {t['tokens_per_s_p50']}")
+        print(f"  prefill chunks (mean): {t['mean_prefill_chunks']}   "
+              f"finish: {t['finish_reasons']}")
+    return 0
+
+
+def _cmd_export_perfetto(args: argparse.Namespace) -> int:
+    parsed = timeline.parse_files(args.logs)
+    if parsed.skipped:
+        print(f"note: skipped {parsed.skipped} unparseable line(s)",
+              file=sys.stderr)
+    if not parsed.spans and not parsed.requests:
+        print("nothing to export: no span or request_trace events found",
+              file=sys.stderr)
+        return 1
+    trace = timeline.to_perfetto(parsed)
+    with open(args.out, "w") as f:
+        json.dump(trace, f)
+    print(f"wrote {len(trace['traceEvents'])} trace events to {args.out} "
+          f"(open at https://ui.perfetto.dev)")
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="graftscope",
+        description="analyze per-rank span/event JSONL: cross-rank step "
+                    "timelines, straggler attribution, request lifecycle "
+                    "traces, Perfetto export")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser(
+        "steps", help="per-step cross-rank timelines, critical-path "
+                      "breakdown and straggler attribution")
+    p.add_argument("logs", nargs="+", help="JSONL files (one per rank, or "
+                                           "interleaved multi-rank)")
+    p.add_argument("--threshold-ms", type=float, default=1.0,
+                   help="minimum absolute lag over the median wall to "
+                        "count a step as straggling (default 1 ms)")
+    p.add_argument("--ratio", type=float, default=1.2,
+                   help="minimum wall/median ratio to count a step as "
+                        "straggling (default 1.2)")
+    p.add_argument("--verbose", "-v", action="store_true",
+                   help="print every step's attribution, not just the "
+                        "summary")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable output")
+    p.set_defaults(fn=_cmd_steps)
+
+    p = sub.add_parser(
+        "requests", help="group sampled request_trace lifecycle events "
+                         "by tenant")
+    p.add_argument("logs", nargs="+")
+    p.add_argument("--json", action="store_true")
+    p.set_defaults(fn=_cmd_requests)
+
+    p = sub.add_parser(
+        "export-perfetto",
+        help="export spans + request traces as Chrome/Perfetto "
+             "trace_event JSON")
+    p.add_argument("logs", nargs="+")
+    p.add_argument("-o", "--out", default="trace.json",
+                   help="output path (default trace.json)")
+    p.set_defaults(fn=_cmd_export_perfetto)
+
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -28,14 +28,21 @@ Design constraints, in order:
   in Loki without ambiguity, and ``last_span`` feeds the heartbeat plane:
   a stalled rank's heartbeat file names the last span that *completed*,
   which is the best available answer to "where is it stuck?" (the hung
-  region is the one that never closed).
+  region is the one that never closed). ``last_span`` is PER-THREAD (like
+  the span stack): the train loop's heartbeat must name the train loop's
+  own last span, not whatever a concurrent serve/prefetch thread closed
+  most recently. Every event also carries a ``thread`` field so graftscope
+  (:mod:`telemetry.timeline`) can separate tracks.
 
 Spans can optionally mirror into a Prometheus histogram
 (``span_duration_ms{span=...}``) when constructed with a *registry* —
-the bridge between the log plane and the pull plane.
+the bridge between the log plane and the pull plane — and into an
+in-memory ring buffer (*ring_size*) that the exporter's ``/debug/spans``
+endpoint serves when the Loki pipeline itself is the thing that's down.
 """
 from __future__ import annotations
 
+import collections
 import threading
 import time
 from typing import Any, TYPE_CHECKING
@@ -98,24 +105,37 @@ class Tracer:
     :class:`~utils.metrics.MetricsLogger` (or None for a record-only tracer
     whose spans still update ``last_span`` and the registry histogram);
     spans shorter than *min_dur_ms* are timed but not emitted (hot inner
-    loops can trace without flooding Loki)."""
+    loops can trace without flooding Loki). *ring_size* > 0 additionally
+    keeps the newest N span records in memory for
+    :meth:`recent_spans` / the exporter's ``/debug/spans`` endpoint."""
 
     def __init__(self, logger: "MetricsLogger | None" = None, *,
                  rank: int = 0, enabled: bool = True,
                  min_dur_ms: float = 0.0,
-                 registry: "MetricsRegistry | None" = None):
+                 registry: "MetricsRegistry | None" = None,
+                 ring_size: int = 0):
         self.logger = logger
         self.rank = rank
         self.enabled = enabled
         self.min_dur_ms = min_dur_ms
-        self.last_span: str | None = None   # most recently COMPLETED span
         self.spans_emitted = 0
         self._emit_warned = False
         self._local = threading.local()
+        self._ring: collections.deque | None = (
+            collections.deque(maxlen=ring_size) if ring_size > 0 else None)
         self._hist = (registry.histogram(
             "span_duration_ms", "traced span duration in milliseconds",
             buckets=_SPAN_BUCKETS_MS, labelnames=("span",))
             if registry is not None else None)
+
+    @property
+    def last_span(self) -> str | None:
+        """The CALLING thread's most recently completed span (None before
+        the first close on this thread). Thread-scoped on purpose: the
+        heartbeat asks from the train loop's thread and must not be
+        answered with a serve-thread span (cross-thread misattribution
+        would name the wrong subsystem in a stall report)."""
+        return getattr(self._local, "last_span", None)
 
     def span(self, name: str, **fields: Any):
         """Open a span; use as a context manager. Nested spans record their
@@ -130,17 +150,31 @@ class Tracer:
             st = self._local.stack = []
         return st
 
+    def recent_spans(self) -> list[dict]:
+        """Newest-last snapshot of the ring buffer (empty when
+        ``ring_size`` was 0) — the ``/debug/spans`` payload."""
+        return list(self._ring) if self._ring is not None else []
+
     def _closed(self, span: _Span, dur_ms: float) -> None:
-        self.last_span = span.name
+        self._local.last_span = span.name
+        thread = threading.current_thread().name
         if self._hist is not None:
             self._hist.labels(span=span.name).observe(dur_ms)
-        if self.logger is None or dur_ms < self.min_dur_ms:
+        if dur_ms < self.min_dur_ms:
+            return
+        if self._ring is not None:
+            self._ring.append({"name": span.name,
+                               "dur_ms": round(dur_ms, 3),
+                               "depth": span.depth, "parent": span.parent,
+                               "rank": self.rank, "thread": thread,
+                               "ts": time.time(), **span.fields})
+        if self.logger is None:
             return
         self.spans_emitted += 1
         try:
             self.logger.emit("span", name=span.name, dur_ms=round(dur_ms, 3),
                              depth=span.depth, parent=span.parent,
-                             rank=self.rank, **span.fields)
+                             rank=self.rank, thread=thread, **span.fields)
         except Exception as e:   # noqa: BLE001 — tracing must never kill
             # the traced work (a full disk under the logger's file is an
             # observability outage, not a training outage).

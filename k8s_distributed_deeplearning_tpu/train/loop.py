@@ -113,12 +113,15 @@ def fit(
             inj.fire("step", step=step)
         if profiler is not None:
             profiler.step_hook(step)
-        with tr.span("data_wait"):
+        # Both hot-loop spans carry step= so graftscope (telemetry/
+        # timeline.py) can align ranks on step number instead of wall
+        # clock — per-rank JSONL clocks start at different t0s.
+        with tr.span("data_wait", step=step):
             if inj is not None:
                 inj.fire("data_wait", step=step)
             batch = next(batch_iter)
         step_rng = jax.random.fold_in(rng, step)
-        with tr.span("step"):
+        with tr.span("step", step=step):
             state, loss, aux = step_fn(state, batch, step_rng)
         if heartbeat is not None and (
                 inj is None or not inj.suppressed("heartbeat", step=step + 1)):

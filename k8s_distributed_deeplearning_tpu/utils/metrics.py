@@ -119,6 +119,8 @@ class ServingStats:
         self.prefix_hit_tokens = 0
         self.prefix_lookup_tokens = 0
         self.prefix_evictions = 0
+        # Sampled end-to-end request_trace events emitted (graftscope).
+        self.request_traces = 0
 
     def _tick(self) -> None:
         now = time.perf_counter()
@@ -157,6 +159,11 @@ class ServingStats:
     def record_prefix_evictions(self, n_blocks: int) -> None:
         self._tick()
         self.prefix_evictions += n_blocks
+
+    def record_request_trace(self) -> None:
+        """One sampled ``request_trace`` lifecycle event was emitted."""
+        self._tick()
+        self.request_traces += 1
 
     def record_completion(self, latency_s: float, n_tokens: int,
                           reason: str) -> None:
@@ -202,6 +209,7 @@ class ServingStats:
             "prefix_cache_hits": self.prefix_hits,
             "prefix_cache_misses": self.prefix_misses,
             "prefix_cache_evictions": self.prefix_evictions,
+            "request_traces_sampled": self.request_traces,
             # Fraction of looked-up prompt tokens served from cached KV
             # (None until the first lookup, i.e. cache disabled or idle).
             "prefix_hit_rate": (

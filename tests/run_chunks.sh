@@ -45,9 +45,13 @@ CHUNKS[faults]="tests/test_faults.py"
 # graftlint (pure-AST, no jax at analysis time): cheap, so it runs first —
 # a schema/axis/hot-path regression fails in seconds, not after compiles.
 CHUNKS[lint]="tests/test_analysis.py"
+# graftscope (telemetry analysis plane): mostly jax-free timeline/parser
+# tests plus engine-integration request-trace cases that compile their own
+# tiny model — split from serve so that chunk stays under its timeout.
+CHUNKS[graftscope]="tests/test_graftscope.py"
 CHUNKS[slow1]="tests/test_train_e2e.py tests/test_multiprocess.py"
 CHUNKS[slow2]="tests/test_multihost_train.py tests/test_multihost_llama.py tests/test_train_zoo.py"
-ORDER=(lint core parallel1 parallel2 moe train llama deploy serve sched faults slow1 slow2)
+ORDER=(lint core parallel1 parallel2 moe train llama deploy serve sched faults graftscope slow1 slow2)
 
 # --- completeness check: every tests/test_*.py in EXACTLY one chunk ------
 # ...and every declared chunk actually in ORDER: a chunk missing from the

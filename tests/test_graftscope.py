@@ -1,0 +1,716 @@
+"""graftscope: the telemetry analysis plane (telemetry/timeline.py + CLI).
+
+Covers the tentpole acceptance criteria: torn-line tolerance, skew-proof
+cross-rank step alignment, chaos-validated straggler attribution (a
+faults-harness data_wait stall on rank 1 must be attributed to rank 1's
+data_wait), Perfetto trace_event schema validity, end-to-end request
+lifecycle traces through the serving engine, the exporter's /debug
+capture surface, and the thread-scoped last_span fix.
+
+Layout mirrors the code: jax-free tests (timeline parsing/attribution,
+CLI, exporter, tracer) run first; the engine-integration request-trace
+tests compile their own tiny model at the bottom.
+"""
+import contextlib
+import io
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from k8s_distributed_deeplearning_tpu.config import JobConfig
+from k8s_distributed_deeplearning_tpu.launch import watch as watch_mod
+from k8s_distributed_deeplearning_tpu.telemetry import (
+    HeartbeatWriter, MetricsExporter, MetricsRegistry, Tracer)
+from k8s_distributed_deeplearning_tpu.telemetry import graftscope, timeline
+from k8s_distributed_deeplearning_tpu.utils.metrics import MetricsLogger
+
+
+def _span_line(name, dur_ms, elapsed_s, *, rank=0, step=None, depth=0,
+               parent=None, thread="MainThread", **fields):
+    rec = {"ts": "2026-01-01T00:00:00", "job": "train", "event": "span",
+           "name": name, "dur_ms": dur_ms, "depth": depth, "parent": parent,
+           "rank": rank, "thread": thread, "elapsed_s": elapsed_s}
+    if step is not None:
+        rec["step"] = step
+    rec.update(fields)
+    return json.dumps(rec)
+
+
+def _rank_log(rank, *, t0, steps, data_wait_ms, step_ms, slow=()):
+    """Synthetic per-rank JSONL: each step is data_wait then the anchor
+    "step" span, on a clock starting at *t0* (per-rank skew). *slow*
+    maps step -> extra data_wait ms for that step on this rank."""
+    slow = dict(slow)
+    lines, t = [], t0
+    for s in range(steps):
+        dw = data_wait_ms + slow.get(s, 0.0)
+        t += dw / 1e3
+        lines.append(_span_line("data_wait", dw, round(t, 6),
+                                rank=rank, step=s))
+        t += step_ms / 1e3
+        lines.append(_span_line("step", step_ms, round(t, 6),
+                                rank=rank, step=s))
+    return lines
+
+
+# ------------------------------------------------------------ parsing
+
+def test_parse_lines_skips_torn_and_garbage_lines():
+    good = _span_line("step", 80.0, 1.0, step=0)
+    torn = _span_line("step", 80.0, 2.0, step=1)[:25]   # killed mid-write
+    lines = [good, torn, "not json at all", "[1, 2, 3]",
+             json.dumps({"event": "span", "name": "step"}),  # no dur/elapsed
+             json.dumps({"event": "train_step", "step": 5, "loss": 0.1}),
+             "", "   "]
+    parsed = timeline.parse_lines(lines)
+    assert [s.name for s in parsed.spans] == ["step"]
+    assert parsed.skipped == 4          # torn + garbage + non-dict + no-dur
+    assert parsed.total_lines == 6      # blank lines aren't lines
+    assert parsed.requests == []        # train_step passes through silently
+
+
+def test_parse_files_torn_final_line_from_killed_rank(tmp_path):
+    """A rank hard-killed mid-write (the faults harness's exit action)
+    leaves a truncated final line; the parser must keep every complete
+    line and count exactly one skip. The shear is deterministic: cut the
+    last record mid-JSON, as a mid-write kill does."""
+    lines = _rank_log(0, t0=0.0, steps=4, data_wait_ms=5.0, step_ms=20.0)
+    path = tmp_path / "rank0.jsonl"
+    path.write_text("\n".join(lines[:-1]) + "\n" + lines[-1][:30])
+    parsed = timeline.parse_files([str(path)])
+    assert parsed.skipped == 1
+    assert len(parsed.spans) == len(lines) - 1
+    # The surviving spans still yield full step timelines for steps 0-2.
+    tl = timeline.build_step_timelines(parsed)
+    assert set(tl) == {0, 1, 2, 3}      # step 3's data_wait survived
+
+
+def test_parse_files_interleaved_ranks_and_default_rank(tmp_path):
+    """One file holding BOTH ranks' events interleaved (a shared stdout
+    stream) splits per the rank field; a file with no rank fields falls
+    back to its position in the argument list."""
+    r0 = _rank_log(0, t0=0.0, steps=2, data_wait_ms=5.0, step_ms=20.0)
+    r1 = _rank_log(1, t0=500.0, steps=2, data_wait_ms=5.0, step_ms=20.0)
+    mixed = tmp_path / "mixed.jsonl"
+    mixed.write_text("\n".join(x for pair in zip(r0, r1) for x in pair))
+    bare = tmp_path / "bare.jsonl"
+    rec = json.loads(_span_line("step", 20.0, 1.0, step=0))
+    del rec["rank"]
+    bare.write_text(json.dumps(rec))
+    parsed = timeline.parse_files([str(mixed), str(bare)])
+    assert parsed.ranks() == [0, 1]
+    assert sum(1 for s in parsed.spans if s.rank == 1) == 5  # 4 mixed + bare
+
+
+# ------------------------------------------- step timelines + attribution
+
+def test_step_timeline_wall_gap_and_nesting():
+    lines = [
+        _span_line("data_wait", 20.0, 0.92, step=0),
+        _span_line("step", 80.0, 1.0, step=0),
+        _span_line("data_wait", 20.0, 1.12, step=1),
+        # Nested span inside "step": must NOT double-count into components.
+        _span_line("allreduce", 30.0, 1.19, step=1, depth=1, parent="step"),
+        _span_line("step", 80.0, 1.2, step=1),
+    ]
+    tl = timeline.build_step_timelines(timeline.parse_lines(lines))
+    s0, s1 = tl[0][0], tl[1][0]
+    # First step per rank: wall falls back to traced total (gap 0).
+    assert s0.components == {"data_wait": 20.0, "step": 80.0}
+    assert s0.wall_ms == pytest.approx(100.0) and s0.gap_ms == 0.0
+    # Second step: wall is the anchor-close spacing (1.2 - 1.0 = 200 ms),
+    # traced is 100 ms, so 100 ms is untraced gap.
+    assert s1.components == {"data_wait": 20.0, "step": 80.0}
+    assert s1.wall_ms == pytest.approx(200.0)
+    assert s1.gap_ms == pytest.approx(100.0)
+    assert s1.breakdown()[timeline.UNTRACED] == pytest.approx(100.0)
+
+
+def test_step_alignment_survives_clock_skew():
+    """Ranks whose elapsed_s clocks start hours apart (pods scheduled at
+    different times) still align per step — wall times come from
+    within-rank deltas only."""
+    parsed = timeline.parse_lines(
+        _rank_log(0, t0=0.0, steps=4, data_wait_ms=5.0, step_ms=20.0)
+        + _rank_log(1, t0=7200.0, steps=4, data_wait_ms=5.0, step_ms=20.0))
+    tl = timeline.build_step_timelines(parsed)
+    assert set(tl) == {0, 1, 2, 3}
+    for step in tl:
+        assert set(tl[step]) == {0, 1}
+        for rec in tl[step].values():
+            assert rec.wall_ms == pytest.approx(25.0, abs=1e-6)
+    # No false stragglers out of pure skew:
+    attrs = timeline.attribute_stragglers(tl)
+    assert not any(a.is_straggler(threshold_ms=1.0, ratio=1.2)
+                   for a in attrs)
+
+
+def test_straggler_attribution_names_rank_and_span():
+    parsed = timeline.parse_lines(
+        _rank_log(0, t0=0.0, steps=5, data_wait_ms=5.0, step_ms=20.0)
+        + _rank_log(1, t0=50.0, steps=5, data_wait_ms=5.0, step_ms=20.0)
+        + _rank_log(2, t0=90.0, steps=5, data_wait_ms=5.0, step_ms=20.0,
+                    slow={2: 100.0, 3: 100.0}))
+    tl = timeline.build_step_timelines(parsed)
+    attrs = {a.step: a for a in timeline.attribute_stragglers(tl)}
+    for step in (2, 3):
+        a = attrs[step]
+        assert a.slowest_rank == 2 and a.span == "data_wait"
+        assert a.is_straggler(threshold_ms=10.0, ratio=1.2)
+        assert a.lag_ms == pytest.approx(100.0, rel=0.05)
+    summary = timeline.straggler_summary(list(attrs.values()),
+                                         threshold_ms=10.0, ratio=1.2)
+    assert summary["straggler_steps"] == 2
+    assert summary["culprits"] == {"rank2:data_wait": 2}
+    assert summary["worst"]["rank"] == 2
+    assert summary["worst"]["span"] == "data_wait"
+    # Critical path: the slowest rank's breakdown per step, summed. Steps
+    # 2-3 bill rank 2's inflated data_wait.
+    path = timeline.critical_path(tl)
+    assert path["data_wait"] == pytest.approx(5 * 5.0 + 2 * 100.0, rel=0.05)
+    assert path["step"] == pytest.approx(5 * 20.0, rel=0.05)
+
+
+def test_attribution_needs_two_ranks():
+    parsed = timeline.parse_lines(
+        _rank_log(0, t0=0.0, steps=3, data_wait_ms=5.0, step_ms=20.0))
+    attrs = timeline.attribute_stragglers(
+        timeline.build_step_timelines(parsed))
+    assert attrs == []   # "straggler" is relative; solo ranks make none
+
+
+# ------------------------------------------------------- Perfetto export
+
+def _assert_valid_trace_events(trace):
+    """Structural validation against the Chrome trace_event contract:
+    object envelope, every event a dict with ph/pid/tid, X events with
+    numeric non-negative ts/dur, M events process_name/thread_name."""
+    assert isinstance(trace, dict)
+    assert trace["displayTimeUnit"] in ("ms", "ns")
+    events = trace["traceEvents"]
+    assert isinstance(events, list) and events
+    for ev in events:
+        assert isinstance(ev, dict)
+        assert ev["ph"] in ("X", "M")
+        assert isinstance(ev["pid"], int) and isinstance(ev["tid"], int)
+        assert isinstance(ev["name"], str)
+        if ev["ph"] == "X":
+            assert isinstance(ev["ts"], (int, float)) and ev["ts"] >= 0
+            assert isinstance(ev["dur"], (int, float)) and ev["dur"] >= 0
+            assert isinstance(ev.get("args", {}), dict)
+        else:
+            assert ev["name"] in ("process_name", "thread_name")
+            assert isinstance(ev["args"]["name"], str)
+
+
+def test_perfetto_export_schema_and_rank_alignment():
+    parsed = timeline.parse_lines(
+        _rank_log(0, t0=0.0, steps=3, data_wait_ms=5.0, step_ms=20.0)
+        + _rank_log(1, t0=3600.0, steps=3, data_wait_ms=5.0, step_ms=20.0))
+    trace = timeline.to_perfetto(parsed)
+    _assert_valid_trace_events(trace)
+    # JSON-serializable as a whole (the file Perfetto actually loads).
+    json.loads(json.dumps(trace))
+    # Alignment: after per-rank offsets, the anchor span of the pivot
+    # (earliest common) step ENDS at the same instant on both tracks —
+    # the 3600 s skew must be gone.
+    ends = {}
+    for ev in trace["traceEvents"]:
+        if (ev["ph"] == "X" and ev["name"] == "step"
+                and ev["args"].get("step") == 0):
+            ends[ev["pid"]] = ev["ts"] + ev["dur"]
+    assert set(ends) == {0, 1}
+    assert ends[0] == pytest.approx(ends[1], abs=1.0)   # µs
+
+
+def test_perfetto_request_track_with_phase_slices():
+    req = {"ts": "t", "job": "serve", "event": "request_trace",
+           "request_id": "req-7", "tenant": "default", "queue_ms": 10.0,
+           "ttft_ms": 40.0, "latency_ms": 100.0, "new_tokens": 5,
+           "finish_reason": "length", "elapsed_s": 2.0}
+    parsed = timeline.parse_lines(
+        _rank_log(0, t0=0.0, steps=2, data_wait_ms=5.0, step_ms=20.0)
+        + [json.dumps(req)])
+    trace = timeline.to_perfetto(parsed)
+    _assert_valid_trace_events(trace)
+    req_pid = max(e["pid"] for e in trace["traceEvents"])
+    assert req_pid == 1          # one past the highest rank
+    names = [e["name"] for e in trace["traceEvents"]
+             if e["pid"] == req_pid and e["ph"] == "X"]
+    assert "req-7" in names
+    # queue -> prefill -> decode child slices partition the latency.
+    phases = {e["name"]: e for e in trace["traceEvents"]
+              if e["pid"] == req_pid and e.get("cat") == "request_phase"}
+    assert set(phases) == {"queue", "prefill", "decode"}
+    assert phases["queue"]["dur"] == pytest.approx(10e3)
+    assert phases["prefill"]["dur"] == pytest.approx(30e3)
+    assert phases["decode"]["dur"] == pytest.approx(60e3)
+
+
+# ------------------------------------------------------------------ CLI
+
+def test_graftscope_steps_cli(tmp_path, capsys):
+    f0, f1 = tmp_path / "rank0.jsonl", tmp_path / "rank1.jsonl"
+    f0.write_text("\n".join(
+        _rank_log(0, t0=0.0, steps=5, data_wait_ms=5.0, step_ms=20.0)))
+    lines1 = _rank_log(1, t0=99.0, steps=5, data_wait_ms=5.0, step_ms=20.0,
+                       slow={3: 200.0})
+    # Torn final line rides along: the CLI must note it and carry on.
+    f1.write_text("\n".join(lines1) + "\n"
+                  + _span_line("step", 1.0, 999.0, rank=1, step=9)[:20])
+    rc = graftscope.main(["steps", str(f0), str(f1), "--threshold-ms", "10"])
+    cap = capsys.readouterr()
+    assert rc == 0
+    assert "skipped 1 unparseable line" in cap.err
+    assert "rank1:data_wait" in cap.out
+    assert "critical path" in cap.out
+
+    rc = graftscope.main(["steps", str(f0), str(f1), "--json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert out["ranks"] == [0, 1] and out["skipped_lines"] == 1
+    assert out["stragglers"]["worst"]["rank"] == 1
+
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text(json.dumps({"event": "train_step", "step": 1}) + "\n")
+    assert graftscope.main(["steps", str(empty)]) == 1
+    capsys.readouterr()
+
+
+def test_graftscope_requests_and_export_cli(tmp_path, capsys):
+    f = tmp_path / "serve.jsonl"
+    recs = [{"event": "request_trace", "request_id": f"req-{i}",
+             "tenant": "acme" if i % 2 else "default", "queue_ms": 5.0 * i,
+             "ttft_ms": 20.0 + i, "latency_ms": 80.0 + i, "new_tokens": 4,
+             "prefill_chunks": 1, "tokens_per_s": 50.0,
+             "finish_reason": "length", "elapsed_s": 1.0 + i}
+            for i in range(6)]
+    f.write_text("\n".join(json.dumps(r) for r in recs))
+    rc = graftscope.main(["requests", str(f)])
+    cap = capsys.readouterr()
+    assert rc == 0
+    assert "6 sampled request trace(s)" in cap.out
+    assert "tenant acme" in cap.out and "tenant default" in cap.out
+
+    rc = graftscope.main(["requests", str(f), "--json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0 and out["requests"] == 6
+    assert out["tenants"]["acme"]["requests"] == 3
+    assert out["tenants"]["acme"]["finish_reasons"] == {"length": 3}
+
+    dest = tmp_path / "trace.json"
+    rc = graftscope.main(["export-perfetto", str(f), "-o", str(dest)])
+    capsys.readouterr()
+    assert rc == 0
+    _assert_valid_trace_events(json.loads(dest.read_text()))
+
+    nothing = tmp_path / "nothing.jsonl"
+    nothing.write_text("")
+    assert graftscope.main(["requests", str(nothing)]) == 1
+    assert graftscope.main(
+        ["export-perfetto", str(nothing), "-o", str(dest)]) == 1
+    capsys.readouterr()
+
+
+# ----------------------------------------------- tracer: thread + ring
+
+def test_last_span_is_thread_scoped():
+    """Regression for the heartbeat misattribution bug: a serve/prefetch
+    thread closing spans concurrently must NOT overwrite the train-loop
+    thread's last_span (the stall report would name the wrong
+    subsystem)."""
+    buf = io.StringIO()
+    tr = Tracer(MetricsLogger(stream=buf, job="t"))
+    seen = {}
+
+    def worker():
+        with tr.span("decode"):
+            pass
+        seen["worker"] = tr.last_span
+
+    with tr.span("step", step=3):
+        pass
+    t = threading.Thread(target=worker, name="serve-thread")
+    t.start()
+    t.join(5)
+    assert seen["worker"] == "decode"
+    assert tr.last_span == "step"      # unchanged on THIS thread
+    by_name = {json.loads(line)["name"]: json.loads(line)
+               for line in buf.getvalue().splitlines()}
+    assert by_name["step"]["thread"] == "MainThread"
+    assert by_name["decode"]["thread"] == "serve-thread"
+
+
+def test_ring_buffer_records_without_logger():
+    tr = Tracer(None, ring_size=3, rank=4)
+    for i in range(5):
+        with tr.span("step", step=i):
+            pass
+    recent = tr.recent_spans()
+    assert [r["step"] for r in recent] == [2, 3, 4]   # newest 3 only
+    assert all(r["rank"] == 4 and r["name"] == "step" for r in recent)
+    assert all("ts" in r and "thread" in r for r in recent)
+    assert Tracer(None).recent_spans() == []          # ring off by default
+
+
+# ----------------------------------------------- exporter debug surface
+
+def _get(url):
+    with urllib.request.urlopen(url) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def test_debug_spans_endpoint():
+    tr = Tracer(None, ring_size=16)
+    with tr.span("step", step=8):
+        pass
+    exp = MetricsExporter(MetricsRegistry(), host="127.0.0.1", port=0,
+                          tracer=tr).start()
+    try:
+        status, body = _get(f"http://127.0.0.1:{exp.port}/debug/spans")
+        assert status == 200 and body["count"] == 1
+        assert body["spans"][0]["name"] == "step"
+        assert body["spans"][0]["step"] == 8
+    finally:
+        exp.stop()
+    # Without a tracer the endpoint 404s instead of crashing.
+    exp = MetricsExporter(MetricsRegistry(), host="127.0.0.1", port=0).start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"http://127.0.0.1:{exp.port}/debug/spans")
+        assert ei.value.code == 404
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{exp.port}/debug/profile?ms=5")
+        assert ei.value.code == 404
+    finally:
+        exp.stop()
+
+
+def test_debug_profile_endpoint(tmp_path):
+    captured = []
+
+    @contextlib.contextmanager
+    def fake_profiler(out):
+        captured.append(out)
+        yield
+
+    exp = MetricsExporter(MetricsRegistry(), host="127.0.0.1", port=0,
+                          profile_dir=str(tmp_path),
+                          profiler=fake_profiler).start()
+    base = f"http://127.0.0.1:{exp.port}"
+    try:
+        status, body = _get(f"{base}/debug/profile?ms=1")
+        assert status == 200 and body["ok"] is True and body["ms"] == 1
+        assert "ondemand-0001" in body["trace_dir"]
+        assert captured == [body["trace_dir"]]
+        # ms is clamped, not rejected, at the edges...
+        status, body = _get(f"{base}/debug/profile?ms=-5")
+        assert status == 200 and body["ms"] == 1
+        # ...but a non-integer is a 400.
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"{base}/debug/profile?ms=soon")
+        assert ei.value.code == 400
+    finally:
+        exp.stop()
+
+
+def test_debug_profile_concurrent_captures_get_409():
+    entered, release = threading.Event(), threading.Event()
+
+    @contextlib.contextmanager
+    def blocking_profiler(out):
+        entered.set()
+        release.wait(10)
+        yield
+
+    exp = MetricsExporter(MetricsRegistry(), host="127.0.0.1", port=0,
+                          profile_dir="/tmp/unused",
+                          profiler=blocking_profiler).start()
+    base = f"http://127.0.0.1:{exp.port}"
+    first = {}
+
+    def go():
+        first["resp"] = _get(f"{base}/debug/profile?ms=1")
+
+    t = threading.Thread(target=go)
+    try:
+        t.start()
+        assert entered.wait(10)
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"{base}/debug/profile?ms=1")
+        assert ei.value.code == 409
+    finally:
+        release.set()
+        t.join(10)
+        exp.stop()
+    assert first["resp"][0] == 200
+
+
+def test_debug_profile_failure_is_500_and_releases_lock():
+    @contextlib.contextmanager
+    def dying_profiler(out):
+        raise RuntimeError("no backend")
+        yield
+
+    exp = MetricsExporter(MetricsRegistry(), host="127.0.0.1", port=0,
+                          profile_dir="/tmp/unused",
+                          profiler=dying_profiler).start()
+    base = f"http://127.0.0.1:{exp.port}"
+    try:
+        for _ in range(2):   # twice: the lock must be released on failure
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(f"{base}/debug/profile?ms=1")
+            assert ei.value.code == 500
+            assert "no backend" in json.loads(ei.value.read())["error"]
+    finally:
+        exp.stop()
+
+
+def test_reply_swallows_broken_pipe():
+    """A scraper hanging up mid-response must not stack-trace the handler
+    (on a worker pod, stderr IS the JSONL log stream)."""
+    exp = MetricsExporter(MetricsRegistry(), host="127.0.0.1", port=0)
+    Handler = exp._handler()
+    h = Handler.__new__(Handler)
+
+    class _BrokenPipe:
+        def write(self, _b):
+            raise BrokenPipeError
+
+    h.send_response = lambda *a, **k: None
+    h.send_header = lambda *a, **k: None
+    h.end_headers = lambda: None
+    h.wfile = _BrokenPipe()
+    h.close_connection = False
+    h._reply(200, "text/plain", b"payload")    # must not raise
+    assert h.close_connection is True
+    exp._server.server_close()
+
+
+# --------------------------------------------- watch: live stragglers
+
+def test_watch_reports_straggler_and_catch_up(tmp_path):
+    """A live rank whose heartbeat step trails the gang is reported BY
+    RANK with its lag and last span — once, then again only after
+    catching up (which is itself reported) and re-lagging."""
+    from tests.test_watch import FakeCluster
+
+    d = str(tmp_path)
+    now = {"t": 1000.0}
+    HeartbeatWriter(d, 0, clock=lambda: now["t"]).beat(50, last_span="step")
+    HeartbeatWriter(d, 1, clock=lambda: now["t"]).beat(
+        12, last_span="data_wait")
+
+    cfg = JobConfig(num_workers=2)
+    cluster = FakeCluster([
+        {"active": 2, "succeeded": 0},
+        {"active": 2, "succeeded": 0},
+        {"active": 0, "succeeded": 2},
+    ])
+    events, fake = [], {"t": 0.0}
+
+    def sleep(dt):
+        fake["t"] += dt
+        # Rank 1 catches up between polls.
+        HeartbeatWriter(d, 1, clock=lambda: now["t"]).beat(
+            50, last_span="step")
+
+    result = watch_mod.watch(
+        cfg, kubectl=watch_mod.Kubectl(runner=cluster.runner),
+        clock=lambda: fake["t"], sleep=sleep,
+        poll_interval=1.0, attempt_timeout=100.0, on_event=events.append,
+        heartbeat_dir=d, heartbeat_stale_after=1e6,
+        heartbeat_clock=lambda: now["t"], straggler_lag_steps=5)
+    assert result.status.succeeded == 2
+    lagging = [e for e in events if "straggling" in e]
+    assert len(lagging) == 1, events
+    assert "rank 1" in lagging[0] and "38 steps behind" in lagging[0]
+    assert "data_wait" in lagging[0]
+    assert not any("rank 0 straggling" in e for e in events)
+    assert any(e == "rank 1 caught up" for e in events)
+    assert not any("stalled" in e for e in events)   # slow, not wedged
+
+
+def test_watch_straggler_off_by_default(tmp_path):
+    from tests.test_watch import FakeCluster
+
+    d = str(tmp_path)
+    HeartbeatWriter(d, 0, clock=lambda: 1000.0).beat(50, last_span="step")
+    HeartbeatWriter(d, 1, clock=lambda: 1000.0).beat(2, last_span="step")
+    cluster = FakeCluster([{"active": 2, "succeeded": 0},
+                           {"active": 0, "succeeded": 2}])
+    events, fake = [], {"t": 0.0}
+    watch_mod.watch(
+        JobConfig(num_workers=2),
+        kubectl=watch_mod.Kubectl(runner=cluster.runner),
+        clock=lambda: fake["t"],
+        sleep=lambda dt: fake.__setitem__("t", fake["t"] + dt),
+        poll_interval=1.0, attempt_timeout=100.0, on_event=events.append,
+        heartbeat_dir=d, heartbeat_stale_after=1e6,
+        heartbeat_clock=lambda: 1000.0)
+    assert not any("straggling" in e for e in events)
+
+
+# ------------------------------------- chaos-validated attribution (jax)
+
+def test_chaos_data_stall_attributed_to_injected_rank():
+    """The acceptance criterion for the analysis plane: inject a
+    data_wait stall on rank 1 through the faults harness, run the REAL
+    train loop per rank, and graftscope must attribute the slow steps to
+    rank 1's data_wait — not to rank 0, not to the step span."""
+    import jax
+
+    from k8s_distributed_deeplearning_tpu import faults
+    from k8s_distributed_deeplearning_tpu.train import loop as train_loop
+
+    plan = faults.FaultPlan(faults=(
+        faults.Fault(site="data_wait", action="stall", rank=1,
+                     after=3, count=2, seconds=0.05),))
+    logs = {}
+    for rank in (0, 1):
+        buf = io.StringIO()
+        tracer = Tracer(MetricsLogger(stream=buf, job="train"), rank=rank)
+        faults.activate(plan, rank=rank)
+        try:
+            train_loop.fit(lambda state, batch, rng: (state, 0.0, {}),
+                           state=None, batches=iter(range(8)), num_steps=8,
+                           rng=jax.random.key(0), tracer=tracer)
+        finally:
+            faults.deactivate()
+        logs[rank] = buf.getvalue()
+
+    parsed = timeline.parse_lines(logs[0].splitlines()).merge(
+        timeline.parse_lines(logs[1].splitlines()))
+    assert parsed.ranks() == [0, 1] and parsed.skipped == 0
+    tl = timeline.build_step_timelines(parsed)
+    attrs = timeline.attribute_stragglers(tl)
+    # after=3, count=2: the stall fires on steps 3 and 4.
+    by_step = {a.step: a for a in attrs}
+    for step in (3, 4):
+        a = by_step[step]
+        assert a.slowest_rank == 1, vars(a)
+        assert a.span == "data_wait", vars(a)
+        assert a.is_straggler(threshold_ms=10.0, ratio=1.2)
+    summary = timeline.straggler_summary(attrs, threshold_ms=10.0,
+                                         ratio=1.2)
+    assert summary["culprits"].get("rank1:data_wait", 0) >= 2
+    assert summary["worst"]["rank"] == 1
+    assert summary["worst"]["span"] == "data_wait"
+
+
+# ------------------------------- request lifecycle traces (jax + model)
+
+@pytest.fixture(scope="module")
+def tiny():
+    import jax
+    import jax.numpy as jnp
+
+    from k8s_distributed_deeplearning_tpu.models import llama
+    cfg = llama.config_tiny(dtype=jnp.float32, max_seq_len=64)
+    model = llama.LlamaLM(cfg)
+    params = model.init(jax.random.key(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    return model, params, cfg
+
+
+def _engine(tiny, **kw):
+    from k8s_distributed_deeplearning_tpu.serve import ServeEngine
+    model, params, _cfg = tiny
+    return ServeEngine(model, params, num_slots=2, eos_id=None, **kw)
+
+
+def _requests(cfg, n, seed=0):
+    import numpy as np
+
+    from k8s_distributed_deeplearning_tpu.serve import Request
+    rng = np.random.default_rng(seed)
+    return [Request(prompt=rng.integers(0, cfg.vocab_size, size=int(
+                rng.integers(4, 17))).astype(np.int32),
+                    max_new_tokens=int(rng.integers(3, 8)))
+            for _ in range(n)]
+
+
+def _traces(buf):
+    return [r for r in (json.loads(line) for line in
+                        buf.getvalue().splitlines())
+            if r["event"] == "request_trace"]
+
+
+def test_request_trace_emitted_per_finished_request(tiny):
+    buf = io.StringIO()
+    eng = _engine(tiny, request_trace_sample=1.0,
+                  request_log=MetricsLogger(stream=buf, job="serve"))
+    reqs = _requests(tiny[2], 5)
+    outs = {o.request_id: o for o in eng.run(reqs)}
+    traces = _traces(buf)
+    assert {t["request_id"] for t in traces} == set(outs)
+    for t in traces:
+        out = outs[t["request_id"]]
+        assert t["finish_reason"] == "length"
+        assert t["tenant"] == "default"
+        assert t["prompt_len"] == out.prompt_len
+        assert t["new_tokens"] == len(out.tokens)
+        assert t["decode_steps"] == len(out.tokens) - 1
+        assert t["prefill_chunks"] >= 1        # at least the sampling chunk
+        assert t["queue_ms"] >= 0
+        assert t["ttft_ms"] is not None and t["ttft_ms"] >= 0
+        assert t["latency_ms"] >= t["ttft_ms"]
+        assert t["tokens_per_s"] > 0
+    assert eng.stats.summary()["request_traces_sampled"] == len(reqs)
+
+
+def test_request_trace_covers_abort_path(tiny):
+    buf = io.StringIO()
+    eng = _engine(tiny, request_trace_sample=1.0,
+                  request_log=MetricsLogger(stream=buf, job="serve"))
+    req = _requests(tiny[2], 1)[0]
+    eng.submit(req)
+    outs = eng.shutdown()
+    traces = _traces(buf)
+    assert [o.finish_reason for o in outs] == ["aborted"]
+    assert len(traces) == 1
+    t = traces[0]
+    assert t["request_id"] == req.request_id
+    assert t["finish_reason"] == "aborted"
+    assert t["ttft_ms"] is None and t["new_tokens"] == 0
+
+
+def test_request_trace_sampling_off_and_deterministic(tiny):
+    import zlib
+
+    buf = io.StringIO()
+    eng = _engine(tiny, request_trace_sample=0.0,
+                  request_log=MetricsLogger(stream=buf, job="serve"))
+    eng.run(_requests(tiny[2], 3))
+    assert _traces(buf) == []
+    assert eng.stats.summary()["request_traces_sampled"] == 0
+
+    eng = _engine(tiny, request_trace_sample=0.5,
+                  request_log=MetricsLogger(stream=io.StringIO(), job="s"))
+    for rid in ("req-a", "req-b", "req-42", "alpha", "beta"):
+        expected = zlib.crc32(rid.encode()) < 0.5 * 2 ** 32
+        assert eng._sampled(rid) is expected     # pure hash, replayable
+
+    with pytest.raises(ValueError):
+        _engine(tiny, request_trace_sample=1.5)
+
+
+def test_request_traces_feed_graftscope_summary(tiny):
+    buf = io.StringIO()
+    eng = _engine(tiny, request_trace_sample=1.0,
+                  request_log=MetricsLogger(stream=buf, job="serve"))
+    eng.run(_requests(tiny[2], 4))
+    parsed = timeline.parse_lines(buf.getvalue().splitlines())
+    summary = timeline.requests_summary(parsed)
+    assert summary["requests"] == 4
+    tenant = summary["tenants"]["default"]
+    assert tenant["requests"] == 4
+    assert tenant["finish_reasons"] == {"length": 4}
+    assert tenant["ttft_p50_ms"] is not None
+    assert tenant["mean_prefill_chunks"] >= 1
+    trace = timeline.to_perfetto(parsed)
+    _assert_valid_trace_events(trace)
