@@ -638,9 +638,10 @@ def measure_serve_prefix(n_requests: int = 12, num_slots: int = 4,
     prompts sharing a *prefix_len*-token system prompt, each with a short
     unique tail and a short decode — TTFT-dominated, so the win IS the
     skipped prefill. Cache off: every admission prefills prefix+tail.
-    Cache on: request 1 populates the trie, the rest paste the prefix and
+    Cache on: request 1 populates the trie, the rest MAP the cached pages
+    into their block tables (refcount bump, zero device copies) and
     prefill only their tail. One full warmup replay per mode covers every
-    compile (decode/prefill/paste/copy-out programs); the timed replay
+    compile (decode/prefill/final-chunk programs); the timed replay
     uses fresh engines (cold trie — population cost honestly included)."""
     import numpy as np
 
@@ -802,6 +803,107 @@ def measure_serve_overhead(n_requests: int = 8, num_slots: int = 4,
         "serve_overhead_config": {"requests": n_requests,
                                   "slots": num_slots, "out_len": out_len,
                                   "repeats": repeats},
+    }
+
+
+def measure_serve_paged(dense_slots: int = 2, slots_multiple: int = 4,
+                        prompt_len: int = 32, out_len: int = 32,
+                        prefix_len: int = 64, tail_len: int = 16,
+                        cache_mb: float = 64.0, seed: int = 0) -> dict:
+    """Paged-KV capacity at fixed HBM, plus copy-free prefix-hit TTFT.
+
+    Capacity arm: the old dense arena bought ``dense_slots`` slots, each
+    preallocated to ``max_seq_len``. The paged pool gets EXACTLY that
+    byte budget (``dense_slots * max_blocks`` pages) but
+    ``slots_multiple``x the slot count; with requests at max_seq/4 mean
+    length, admission back-pressure (the scheduler's ``fits`` probe)
+    admits as many as genuinely fit. Peak resident requests over the run
+    divided by ``dense_slots`` is the slots-at-fixed-HBM ratio — the
+    ISSUE's >= 2x gate.
+
+    Prefix arm: miss TTFT (cold trie, full prefill) vs hit TTFT (prefix
+    pages MAPPED into the slot's block table — a refcount bump, zero
+    per-block device copies — so only the unique tail is prefilled)."""
+    import numpy as np
+
+    from k8s_distributed_deeplearning_tpu.serve import Request, ServeEngine
+
+    max_seq = 256
+    model, params, cfg, on_cpu = _serve_cpu_model(max_seq)
+    rng = np.random.default_rng(seed)
+
+    bt = 32
+    max_blocks = -(-max_seq // bt)
+    budget_pages = dense_slots * max_blocks      # the dense arena's HBM
+    num_slots = dense_slots * slots_multiple
+    n_requests = num_slots * 3
+    prompts = [rng.integers(0, cfg.vocab_size, size=prompt_len)
+               .astype(np.int32) for _ in range(n_requests)]
+
+    def run_paged():
+        eng = ServeEngine(model, params, num_slots=num_slots,
+                          max_queue=n_requests, eos_id=None,
+                          prefix_block_tokens=bt,
+                          kv_pool_pages=budget_pages)
+        for p in prompts:
+            eng.submit(Request(prompt=p, max_new_tokens=out_len))
+        peak = 0
+        t0 = time.perf_counter()
+        while eng.busy():
+            eng.step()
+            resident = (sum(s is not None for s in eng._slots)
+                        + len(eng._pending))
+            peak = max(peak, resident)
+        dt = time.perf_counter() - t0
+        return peak, dt, eng.stats.summary()
+
+    run_paged()                                # warmup replay (compiles)
+    peak, dt, summ = run_paged()
+    ratio = peak / dense_slots
+    total = n_requests * out_len
+
+    # Prefix arm: one engine, two admissions sharing a prefix — the
+    # second maps the trie's pages and prefills only its tail.
+    shared = rng.integers(0, cfg.vocab_size, size=prefix_len)
+
+    def ttft_pair():
+        eng = ServeEngine(model, params, num_slots=2,
+                          prefix_cache_mb=cache_mb,
+                          prefix_block_tokens=bt)
+        out = []
+        for _ in range(2):
+            tail = rng.integers(0, cfg.vocab_size, size=tail_len)
+            p = np.concatenate([shared, tail]).astype(np.int32)
+            seen: dict[str, float] = {}
+            t0 = time.perf_counter()
+            eng.run([Request(prompt=p, max_new_tokens=4,
+                             on_token=lambda _t: seen.setdefault(
+                                 "t", time.perf_counter()))])
+            out.append(seen["t"] - t0)
+        assert eng.stats.prefix_hits >= 1, "second admission must hit"
+        return out
+
+    ttft_pair()                                # warmup replay (compiles)
+    miss_s, hit_s = ttft_pair()
+
+    return {
+        "serve_paged_slots_ratio": round(ratio, 2),
+        "serve_paged_peak_resident": peak,
+        "serve_paged_dense_slots_equiv": dense_slots,
+        "serve_paged_pool_pages": budget_pages,
+        "serve_paged_tokens_per_sec": round(total / dt, 1),
+        "serve_paged_pages_used": summ["kv_pages_used"],
+        "serve_paged_miss_ttft_ms": round(miss_s * 1e3, 3),
+        "serve_paged_hit_ttft_ms": round(hit_s * 1e3, 3),
+        "serve_paged_hit_ttft_speedup": round(miss_s / hit_s, 2),
+        "serve_paged_config": {
+            "requests": n_requests, "slots": num_slots,
+            "page_tokens": bt, "max_seq": max_seq,
+            "prompt_len": prompt_len, "out_len": out_len,
+            "prefix_len": prefix_len, "tail_len": tail_len,
+            "model": ("cpu-serve (dim 256, 4L, 32k vocab, f32)" if on_cpu
+                      else "llama-small 124M bf16"),
+        },
     }
 
 
@@ -1379,12 +1481,29 @@ def main() -> None:
         extra.update(measure_serve_prefix())
         extra.update(measure_serve_chunked())
         extra.update(measure_serve_overhead())
+        extra.update(measure_serve_paged())
         emit({
             "metric": "serve_tokens_per_sec",
             "value": extra["serve_tokens_per_sec"],
             "unit": "tokens/sec",
             "vs_baseline": extra["serve_speedup_vs_static"],
             "extra": extra})
+        # The ISSUE's absolute gates, independent of the stored baseline:
+        # at the dense arena's HBM budget the paged pool must hold >= 2x
+        # the slots, and an enabled-but-empty prefix cache must cost < 2%
+        # per step.
+        gates = []
+        if extra["serve_paged_slots_ratio"] < 2.0:
+            gates.append("GATE serve_paged_slots_ratio: "
+                         f"{extra['serve_paged_slots_ratio']} < 2.0")
+        if extra["serve_prefix_empty_overhead_pct"] >= 2.0:
+            gates.append("GATE serve_prefix_empty_overhead_pct: "
+                         f"{extra['serve_prefix_empty_overhead_pct']}"
+                         " >= 2.0")
+        for g in gates:
+            print(g, file=sys.stderr)
+        if gates:
+            sys.exit(2)
         return
     if args.suite == "sched":
         extra = measure_serve_sched()

@@ -55,7 +55,8 @@ def prefill(model, params: PyTree, prompt: jax.Array, *,
 def prefill_chunk(model, params: PyTree, cache: PyTree, chunk: jax.Array, *,
                   start: jax.Array | int | None = None,
                   positions: jax.Array | None = None,
-                  segment_ids: jax.Array | None = None
+                  segment_ids: jax.Array | None = None,
+                  block_tables: jax.Array | None = None
                   ) -> tuple[jax.Array, PyTree]:
     """Resume prefill on an EXISTING cache: run ``chunk`` ([B, C] int32)
     through the shared-cursor decode path starting at cache position
@@ -72,6 +73,11 @@ def prefill_chunk(model, params: PyTree, cache: PyTree, chunk: jax.Array, *,
     the step, which lets the serving engine (a) resume after splicing a
     cached prefix whose cursor is mid-prompt and (b) re-run an overlapping
     final chunk idempotently (rewinding rewrites identical KV in place).
+
+    With ``block_tables`` the cache is a paged pool (no ``cache_index``
+    leaves — ``start`` is then a no-op) and the caller MUST pass explicit
+    ``positions``: the paged scatter derives each token's (page, offset)
+    from its absolute position, not from any cursor.
     """
     if start is not None:
         def set_cursor(path, x):
@@ -84,6 +90,8 @@ def prefill_chunk(model, params: PyTree, cache: PyTree, chunk: jax.Array, *,
         kw["positions"] = positions
     if segment_ids is not None:
         kw["segment_ids"] = segment_ids
+    if block_tables is not None:
+        kw["block_tables"] = block_tables
     logits, vars_ = model.apply({"params": params, "cache": cache}, chunk,
                                 decode=True, mutable=["cache"], **kw)
     return logits, vars_["cache"]
@@ -109,7 +117,8 @@ def decode_step(model, params: PyTree, cache: PyTree, token: jax.Array, *,
 
 
 def slot_decode_step(model, params: PyTree, cache: PyTree,
-                     tokens: jax.Array, slot_positions: jax.Array
+                     tokens: jax.Array, slot_positions: jax.Array,
+                     block_tables: jax.Array | None = None
                      ) -> tuple[jax.Array, PyTree]:
     """One SLOT decode step: row i's ``tokens[i]`` is written at that
     row's own cursor ``slot_positions[i]`` ([B] int32) and attends to its
@@ -119,11 +128,18 @@ def slot_decode_step(model, params: PyTree, cache: PyTree,
     logits [B, V]. The caller owns cursor arithmetic (pass position =
     tokens-written-so-far for each row) and must keep ``slot_positions``
     within ``max_seq_len``; stale KV beyond a row's cursor is never
-    attended, so freed slots are reusable without clearing."""
+    attended, so freed slots are reusable without clearing.
+
+    ``block_tables`` ([B, n_blocks] int32) switches the cache to the paged
+    pool layout: row i writes at page ``block_tables[i, pos // bt]``,
+    offset ``pos % bt``, and attends its table-gathered prefix."""
+    kw: dict = {}
+    if block_tables is not None:
+        kw["block_tables"] = block_tables
     logits, vars_ = model.apply({"params": params, "cache": cache},
                                 tokens[:, None], decode=True,
                                 cache_positions=slot_positions,
-                                mutable=["cache"])
+                                mutable=["cache"], **kw)
     return logits[:, -1, :], vars_["cache"]
 
 

@@ -37,12 +37,14 @@ class LlamaLM(nn.Module):
                  attention_fn=None,
                  decode: bool = False,
                  cache_positions: jax.Array | None = None,
+                 block_tables: jax.Array | None = None,
                  return_hidden: bool = False) -> jax.Array:
         x = Transformer(self.cfg, name="transformer")(
             tokens, positions=positions, segment_ids=segment_ids,
             deterministic=deterministic,
             attention_fn=attention_fn, decode=decode,
-            cache_positions=cache_positions)
+            cache_positions=cache_positions,
+            block_tables=block_tables)
         if return_hidden:
             # Final hidden states for a chunked LM-head loss
             # (ops/chunked_ce.py). Only valid at apply time: init must take

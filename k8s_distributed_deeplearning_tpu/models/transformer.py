@@ -248,6 +248,21 @@ class Attention(nn.Module):
     re-filled by a new request's prefill without clearing the stale K/V the
     previous occupant left behind. The shared scalar ``cache_index`` is
     untouched: per-slot lengths are the caller's registers.
+
+    ``block_tables`` ([B, n_blocks] int32) selects PAGED decode mode: the
+    cache leaves are one POOL of fixed-size KV pages
+    (``[num_pages, page_tokens, kv·hd]``) shared by every row, and each
+    row's table maps its virtual sequence onto pool pages
+    (vLLM's PagedAttention layout). The caller (serve/engine.py) owns
+    allocation/refcounts; this module only scatters the chunk's tokens at
+    ``(table[pos // page_tokens], pos % page_tokens)`` and gathers the
+    row's pages back for attention. Page 0 is the caller's reserved
+    SCRATCH page: table entries default to it and out-of-table writes are
+    redirected there, so right-pad garbage never lands where a live row
+    attends. Composes with ``cache_positions`` (paged slot decode) or with
+    explicit ``positions`` (paged chunk prefill at any start — the
+    token-granular scatter has no ``dynamic_update_slice`` clamping
+    hazard, so a right-padded tail chunk is safe at any cursor).
     """
 
     cfg: TransformerConfig
@@ -259,7 +274,8 @@ class Attention(nn.Module):
                  segment_ids: jax.Array | None = None,
                  attention_fn: Callable | None = None,
                  decode: bool = False,
-                 cache_positions: jax.Array | None = None) -> jax.Array:
+                 cache_positions: jax.Array | None = None,
+                 block_tables: jax.Array | None = None) -> jax.Array:
         cfg = self.cfg
         hd = cfg.resolved_head_dim
         q = nn.DenseGeneral((cfg.n_heads, hd), axis=-1, use_bias=False,
@@ -299,54 +315,124 @@ class Attention(nn.Module):
                         "slot decode isolates rows by construction (each "
                         "slot is one request); segment_ids have no meaning "
                         "here")
-            # Cache layout [B, S, kv·hd] — heads FOLDED into the lane dim.
-            # The natural [B, S, kv, hd] layout tiles its (kv, hd) minors
-            # to (8, 128): at 4 KV heads × head_dim 64 the buffer occupies
-            # 4× its logical bytes, and the per-step update measured
-            # ~82 µs (a full padded-buffer copy at HBM rate — the decode
-            # trace's top non-matmul cost). Folded, the same update
-            # measures 3.9 µs (in-place sliver write, no padding); the
-            # attention-side unfold is a cheap view (round 5).
-            cached_k = self.variable("cache", "cached_key", jnp.zeros,
-                                     (b, cfg.max_seq_len, kv * hd), cfg.dtype)
-            cached_v = self.variable("cache", "cached_value", jnp.zeros,
-                                     (b, cfg.max_seq_len, kv * hd), cfg.dtype)
-            # Per-position document ids, same contract as training: decode
-            # queries attend only cache entries with THEIR document id.
-            # id 0 marks left-padding (batched serving pads unequal prompts
-            # at the FRONT); pad K/V enter the cache but are never attended.
-            # The STATIC presence of segment_ids selects the masked variant
-            # — plain decode pays nothing — so a caller that prefills with
-            # segment_ids must pass them on every decode step too (the
-            # padded/packed generate paths do).
-            use_seg = segment_ids is not None
-            cached_seg = self.variable("cache", "cached_seg", jnp.ones,
-                                       (b, cfg.max_seq_len), jnp.int32)
-            cache_index = self.variable("cache", "cache_index",
-                                        lambda: jnp.zeros((), jnp.int32))
-            if cache_positions is not None:
-                # Slot mode: per-row cursors own positions; the shared
-                # scalar cursor and the seg-validity machinery stay idle.
+            if block_tables is not None:
+                # Paged mode: the "cache" collection holds ONE pool of
+                # fixed-size pages [num_pages, page_tokens, kv·hd] shared
+                # by every row — there is no sensible per-call init (pool
+                # sizing is an engine capacity decision), so a missing
+                # pool is a caller bug, not something to zero-fill.
+                if segment_ids is not None:
+                    raise NotImplementedError(
+                        "paged decode isolates rows via per-row block "
+                        "tables; segment_ids have no meaning here")
+
+                def _pool_missing():
+                    raise ValueError(
+                        "paged decode (block_tables) requires an engine-"
+                        "provided page-pool cache; it cannot be "
+                        "initialised from inside the model")
+
+                cached_k = self.variable("cache", "cached_key",
+                                         _pool_missing)
+                cached_v = self.variable("cache", "cached_value",
+                                         _pool_missing)
                 if positions is None:
+                    if cache_positions is None:
+                        raise ValueError(
+                            "paged chunk prefill requires explicit "
+                            "positions (the chunk's absolute write "
+                            "positions); only slot decode can derive "
+                            "them from cache_positions")
                     positions = cache_positions[:, None]
             else:
-                cur = cache_index.value
-                if use_seg:
-                    seg_now = segment_ids.astype(jnp.int32)
-                    cached_seg.value = jax.lax.dynamic_update_slice(
-                        cached_seg.value, seg_now, (0, cur))
-                segment_ids = None     # consumed into the cache mask below
-                if positions is None:
-                    # Absolute positions for RoPE: the cache cursor onward.
-                    # (Left-padded callers pass explicit per-row positions.)
-                    positions = (cur + jnp.arange(sq))[None, :]
+                # Cache layout [B, S, kv·hd] — heads FOLDED into the lane
+                # dim. The natural [B, S, kv, hd] layout tiles its
+                # (kv, hd) minors to (8, 128): at 4 KV heads × head_dim 64
+                # the buffer occupies 4× its logical bytes, and the
+                # per-step update measured ~82 µs (a full padded-buffer
+                # copy at HBM rate — the decode trace's top non-matmul
+                # cost). Folded, the same update measures 3.9 µs (in-place
+                # sliver write, no padding); the attention-side unfold is
+                # a cheap view (round 5).
+                cached_k = self.variable("cache", "cached_key", jnp.zeros,
+                                         (b, cfg.max_seq_len, kv * hd),
+                                         cfg.dtype)
+                cached_v = self.variable("cache", "cached_value", jnp.zeros,
+                                         (b, cfg.max_seq_len, kv * hd),
+                                         cfg.dtype)
+                # Per-position document ids, same contract as training:
+                # decode queries attend only cache entries with THEIR
+                # document id. id 0 marks left-padding (batched serving
+                # pads unequal prompts at the FRONT); pad K/V enter the
+                # cache but are never attended. The STATIC presence of
+                # segment_ids selects the masked variant — plain decode
+                # pays nothing — so a caller that prefills with
+                # segment_ids must pass them on every decode step too (the
+                # padded/packed generate paths do).
+                use_seg = segment_ids is not None
+                cached_seg = self.variable("cache", "cached_seg", jnp.ones,
+                                           (b, cfg.max_seq_len), jnp.int32)
+                cache_index = self.variable("cache", "cache_index",
+                                            lambda: jnp.zeros((), jnp.int32))
+                if cache_positions is not None:
+                    # Slot mode: per-row cursors own positions; the shared
+                    # scalar cursor and the seg-validity machinery stay
+                    # idle.
+                    if positions is None:
+                        positions = cache_positions[:, None]
+                else:
+                    cur = cache_index.value
+                    if use_seg:
+                        seg_now = segment_ids.astype(jnp.int32)
+                        cached_seg.value = jax.lax.dynamic_update_slice(
+                            cached_seg.value, seg_now, (0, cur))
+                    segment_ids = None  # consumed into the cache mask below
+                    if positions is None:
+                        # Absolute positions for RoPE: the cache cursor
+                        # onward. (Left-padded callers pass explicit
+                        # per-row positions.)
+                        positions = (cur + jnp.arange(sq))[None, :]
 
         if cfg.position == "rope":
             cos, sin = rope_frequencies(hd, cfg.max_seq_len, cfg.rope_theta)
             q = apply_rope(q, cos, sin, positions)
             k = apply_rope(k, cos, sin, positions)
 
-        if decode and cache_positions is not None:
+        if decode and block_tables is not None:
+            # Paged write + gather-attend. Each token of the chunk lands at
+            # (page, offset) = (table[pos // page_tokens], pos % page_tokens)
+            # via a token-granular scatter — unlike dynamic_update_slice
+            # there is no start-clamping hazard, so a right-padded tail
+            # chunk is safe at ANY cursor: pad tokens past the table's last
+            # block are redirected to the scratch page (0) explicitly
+            # rather than relying on XLA out-of-bounds semantics. Reads
+            # gather the row's pages back into a [B, n_blocks·page_tokens]
+            # virtual sequence and mask col <= pos — allocated-but-unwritten
+            # tail positions and scratch garbage are never attended.
+            b, sq = x.shape[0], x.shape[1]
+            kv = cfg.resolved_kv_heads
+            pool_k, pool_v = cached_k.value, cached_v.value
+            page_tokens = pool_k.shape[-2]
+            n_blocks = block_tables.shape[1]
+            wpos = positions.astype(jnp.int32)                    # [B, sq]
+            blk = wpos // page_tokens
+            pg = jnp.take_along_axis(block_tables,
+                                     jnp.minimum(blk, n_blocks - 1), axis=1)
+            pg = jnp.where(blk >= n_blocks, 0, pg)                # scratch
+            off = wpos % page_tokens
+            pool_k = pool_k.at[pg, off].set(
+                k.reshape(b, sq, kv * hd).astype(pool_k.dtype))
+            pool_v = pool_v.at[pg, off].set(
+                v.reshape(b, sq, kv * hd).astype(pool_v.dtype))
+            cached_k.value, cached_v.value = pool_k, pool_v
+            s_virt = n_blocks * page_tokens
+            k_all = pool_k[block_tables].reshape(b, s_virt, kv, hd)
+            v_all = pool_v[block_tables].reshape(b, s_virt, kv, hd)
+            col = jnp.arange(s_virt)
+            dmask = (col[None, None, :] <= wpos[:, :, None])[:, None]
+            out = attention_ops.multi_head_attention(
+                q, k_all, v_all, causal=False, mask=dmask, impl="xla")
+        elif decode and cache_positions is not None:
             # Slot decode: the [B, 1] chunk scatters into per-row columns
             # (each slot's own cursor) and each row attends its prefix
             # col <= cursor — including the just-written token, so even a
@@ -488,7 +574,8 @@ class Block(nn.Module):
                  deterministic: bool = True,
                  attention_fn: Callable | None = None,
                  decode: bool = False,
-                 cache_positions: jax.Array | None = None) -> jax.Array:
+                 cache_positions: jax.Array | None = None,
+                 block_tables: jax.Array | None = None) -> jax.Array:
         cfg = self.cfg
         attention_fn = attention_fn or self.attention_fn
         h = make_norm(cfg, "attn_norm")(x)
@@ -496,7 +583,8 @@ class Block(nn.Module):
                                         segment_ids=segment_ids,
                                         attention_fn=attention_fn,
                                         decode=decode,
-                                        cache_positions=cache_positions)
+                                        cache_positions=cache_positions,
+                                        block_tables=block_tables)
         if cfg.dropout_rate:
             h = nn.Dropout(cfg.dropout_rate, deterministic=deterministic)(h)
         x = x + h
@@ -531,7 +619,8 @@ class Transformer(nn.Module):
                  deterministic: bool = True,
                  attention_fn: Callable | None = None,
                  decode: bool = False,
-                 cache_positions: jax.Array | None = None) -> jax.Array:
+                 cache_positions: jax.Array | None = None,
+                 block_tables: jax.Array | None = None) -> jax.Array:
         cfg = self.cfg
         if tokens_or_embeds.dtype in (jnp.int32, jnp.int64):
             x = nn.Embed(cfg.vocab_size, cfg.dim, dtype=cfg.dtype,
@@ -576,6 +665,8 @@ class Transformer(nn.Module):
         dkw = {"decode": True} if decode else {}
         if cache_positions is not None:
             dkw["cache_positions"] = cache_positions
+        if block_tables is not None:
+            dkw["block_tables"] = block_tables
         if cfg.scan_layers:
             x, _ = nn.scan(
                 lambda mdl, carry, _: (

@@ -1,12 +1,17 @@
-"""Continuous-batching serving (iteration-level scheduling over a slot arena).
+"""Continuous-batching serving (iteration-level scheduling over a paged KV
+pool).
 
 The one-shot :func:`models.generate.generate` path pins a batch's wall-clock
 to its longest request; this package serves mixed-length traffic through ONE
-shape-static compiled decode step over a persistent per-layer KV arena, with
-freed slots re-admitted in flight (Orca-style iteration scheduling + vLLM-style
-slot reuse). See :mod:`serve.engine` for the design contract.
+shape-static compiled decode step over a persistent paged KV pool — block
+tables map each slot's virtual sequence onto refcounted fixed-size pages
+(vLLM's PagedAttention layout), so HBM is paid per live token and the
+prefix trie shares pages into slots with zero device copies — with freed
+slots re-admitted in flight (Orca-style iteration scheduling). See
+:mod:`serve.engine` for the design contract.
 """
 from k8s_distributed_deeplearning_tpu.serve.engine import ServeEngine
+from k8s_distributed_deeplearning_tpu.serve.page_pool import PagePool
 from k8s_distributed_deeplearning_tpu.serve.prefix_cache import PrefixCache
 from k8s_distributed_deeplearning_tpu.serve.request import (
     QueueFull, Request, RequestOutput, SamplingParams)
@@ -15,5 +20,6 @@ from k8s_distributed_deeplearning_tpu.serve.sched import (
 from k8s_distributed_deeplearning_tpu.serve.scheduler import RequestQueue
 
 __all__ = ["ServeEngine", "Request", "RequestOutput", "SamplingParams",
-           "RequestQueue", "QueueFull", "PrefixCache", "TenantConfig",
-           "TenantScheduler", "DEFAULT_TENANT", "load_tenants"]
+           "RequestQueue", "QueueFull", "PagePool", "PrefixCache",
+           "TenantConfig", "TenantScheduler", "DEFAULT_TENANT",
+           "load_tenants"]

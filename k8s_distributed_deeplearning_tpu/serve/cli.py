@@ -42,9 +42,16 @@ def main(argv: list[str] | None = None) -> int:
                          "(models fleet traffic with a common system "
                          "prompt — the prefix cache's target workload)")
     ap.add_argument("--prefix-cache-mb", type=float, default=0.0,
-                    help="prefix-reuse KV cache budget in MiB (0 = off): "
-                         "prompts sharing a prefix splice its cached KV "
-                         "instead of recomputing it")
+                    help="prefix-reuse trie budget in MiB (0 = off): "
+                         "prompts sharing a prefix MAP its cached pages "
+                         "into their block tables instead of recomputing "
+                         "— the bytes draw from the shared paged KV pool "
+                         "(--kv-pool-pages), not a separate arena")
+    ap.add_argument("--kv-pool-pages", type=int, default=0,
+                    help="size of the shared paged KV pool in pages "
+                         "(0 = num_slots * max_blocks, the dense-arena "
+                         "equivalent); smaller pools trade peak "
+                         "concurrency for HBM via admission back-pressure")
     ap.add_argument("--prefill-chunk-tokens", type=int, default=0,
                     help="bound each iteration's prefill work to this many "
                          "prompt tokens (0 = off); must be a multiple of "
@@ -96,6 +103,9 @@ def main(argv: list[str] | None = None) -> int:
     if args.prefix_cache_mb < 0:
         ap.error(f"--prefix-cache-mb must be >= 0, got "
                  f"{args.prefix_cache_mb}")
+    if args.kv_pool_pages < 0:
+        ap.error(f"--kv-pool-pages must be >= 0, got "
+                 f"{args.kv_pool_pages}")
     if args.shared_prefix_len < 0:
         ap.error(f"--shared-prefix-len must be >= 0, got "
                  f"{args.shared_prefix_len}")
@@ -160,6 +170,7 @@ def main(argv: list[str] | None = None) -> int:
         eos_id=args.eos_id, tracer=tracer, tenants=tenant_cfgs,
         prefill_chunk_tokens=args.prefill_chunk_tokens or None,
         prefix_cache_mb=args.prefix_cache_mb or None,
+        kv_pool_pages=args.kv_pool_pages or None,
         request_trace_sample=args.request_trace_sample,
         request_log=logger)
     exporter = None

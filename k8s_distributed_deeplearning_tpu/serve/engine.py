@@ -1,51 +1,64 @@
-"""Continuous-batching engine: ONE compiled decode step over a slot arena,
-with prefix-reuse KV caching and chunked prefill on the admission path.
+"""Continuous-batching engine: ONE compiled decode step over a PAGED KV
+pool, with prefix-reuse page sharing and chunked prefill on the admission
+path.
 
 Design contract (the compile-once discipline that makes in-flight admission
-free):
+free, plus the paged-pool discipline that makes HBM proportional to LIVE
+tokens):
 
-- The KV cache is a persistent ``[num_slots, max_seq_len, kv·head_dim]``
-  per-layer ARENA (the folded-head decode layout, models/transformer.py).
-  Slots are the unit of admission. Each slot carries a host-side register
-  file (last token, KV length = next write position, sampling params, PRNG
-  key) that enters the decode program as small ``[num_slots]`` operands.
+- The KV cache is ONE pool of fixed-size pages per cache leaf
+  (``[num_pages, page_tokens, kv·head_dim]``, the folded-head decode
+  layout — vLLM's PagedAttention block-table design). Each decode slot
+  owns a host-side block table (``[max_blocks]`` int32 row) mapping its
+  virtual sequence onto pool pages; the model's paged decode branch
+  (models/transformer.py) scatters each written token at
+  ``(table[pos // page_tokens], pos % page_tokens)`` and gathers the
+  table's pages back for attention. HBM is paid per ALLOCATED page, so a
+  pool sized for N worst-case slots serves far more short-request slots
+  concurrently — the dense ``[num_slots, max_seq_len, ·]`` arena this
+  replaced paid worst-case HBM per slot unconditionally.
+- Page bookkeeping is host-side (serve/page_pool.py): pages are
+  refcounted so the prefix trie and any number of slots can share one
+  page; admission allocates the prompt's pages and RESERVES the request's
+  worst-case decode growth (``max_new_tokens - 1`` positions), making the
+  mid-decode page-boundary allocation infallible — back-pressure exists
+  only at admission, where the scheduler's ``fits`` probe defers any
+  request whose page need exceeds the pool's availability (evicting
+  unpinned trie pages first). Terminal states deref the slot's pages and
+  return unused growth headroom.
 - The decode step is SHAPE-STATIC: ``slot_decode_step`` writes each slot's
-  token at that slot's own cursor and masks attention to ``col <= cursor``
-  per row (slot mode in models/transformer.py), so slots live independent
-  lifetimes inside one program. It compiles exactly once and reruns for
-  every serving iteration regardless of admissions or completions —
-  asserted via jit cache-size instrumentation in tests/test_serve.py.
-- Admission builds a SINGLE-ROW prefill cache per request and splices it
-  into the freed slot with ``dynamic_update_slice``; the slot rejoins the
-  decode batch on the next iteration — no drain, no recompile. The row
-  cache is filled from up to three sources, all shape-static:
+  token at that slot's own cursor through its block table, so slots live
+  independent lifetimes inside one program. It compiles exactly once and
+  reruns for every serving iteration regardless of admissions, completions
+  or page churn — block tables are a traced int32 operand, never a shape.
+- Admission prefills DIRECTLY into pool pages (no single-row side cache,
+  no splice). The prompt is filled from up to three sources, all
+  shape-static:
 
-  1. **Prefix cache** (``prefix_cache_mb``): the longest trie-cached prefix
-     of the prompt is PASTED block-by-block (``_paste_program``, one
-     compile) instead of recomputed — serve/prefix_cache.py owns the trie,
-     LRU eviction, and the refcounts that pin a matched segment until its
-     splice lands. Completed prefills insert their prompt KV back
-     (``_copyout_program``), so a fleet-wide system prompt is prefilled
-     once, not N times.
+  1. **Prefix cache** (``prefix_cache_mb``): the longest trie-cached
+     prefix of the prompt is MAPPED — each matched trie node's page id is
+     written into the slot's block table and ref'd — with ZERO device
+     copies (serve/prefix_cache.py owns the trie, LRU eviction, and the
+     refcounts that pin matched segments until their pages are mapped).
+     Completed prefills insert their prompt blocks back by handing the
+     trie a reference to the slot's own pages — a fleet-wide system
+     prompt is prefilled once and thereafter shared by table mapping.
   2. **Intermediate chunks** (``prefill_chunk_tokens``): the uncached
      suffix is carved into exact C-token chunks (``_chunk_program``, one
-     compile per C) resumed across engine iterations, each iteration's
-     prefill work budgeted to C real tokens — a 4k prompt no longer
-     freezes the other slots' token streams between two of their tokens.
+     compile per C) resumed across engine iterations, each writing
+     through the slot's table at explicit absolute positions.
   3. **Final chunk** (``_final_chunk_program``, one compile per
      power-of-two bucket): finishes the suffix and samples the first
-     token. When the remaining tail would need right-padding at a nonzero
-     start (``dynamic_update_slice`` CLAMPS out-of-range starts — a
-     padded tail chunk at the sequence end would write misaligned), the
-     engine instead re-feeds the last ``bucket`` REAL tokens with the
-     cursor rewound: recomputed KV is bit-identical to what it overwrites
-     (same tokens, same absolute positions), so the overlap is idempotent
-     and costs at most one extra bucket of compute.
+     token. The chunk resumes at the prefill cursor RIGHT-PADDED — the
+     token-granular paged scatter has no ``dynamic_update_slice``
+     clamping hazard, so no rewind/overlap is ever needed, and pad
+     writes past the table land in the pool's reserved scratch page
+     (page 0), never in a shared page.
 
-- Stale-KV safety: columns beyond a slot's cursor are never attended, and
-  decode writes land at the cursor BEFORE attention reads, so freed slots
-  are reusable without clearing and right-pad garbage in the prefill
-  bucket is progressively overwritten unobserved.
+- Stale-KV safety: virtual column == absolute position, attention masks
+  ``col <= cursor`` per row, and decode writes land at the cursor BEFORE
+  attention reads — so freed pages are reusable without clearing and
+  right-pad garbage is never attended.
 - Per-slot sampling params are traced array operands (``temperature <= 0``
   => greedy; ``top_k == 0`` / ``top_p == 1.0`` => off), so heterogeneous
   sampling across slots never recompiles.
@@ -53,9 +66,10 @@ free):
 Greedy decoding through this engine is token-identical to one-shot
 ``generate()`` for the same prompt — on the cold path, the prefix-hit path
 AND the chunked-prefill path: KV projections are per-token, the attended
-region per position is independent of how the prompt was fed, and masked
-columns contribute exactly zero (parity asserted in tests/test_serve.py
-and tests/test_prefix_cache.py).
+region per position is independent of how the prompt was fed or which
+pages hold it, and masked columns contribute exactly zero (parity asserted
+in tests/test_serve.py, tests/test_prefix_cache.py and
+tests/test_paged_kv.py).
 """
 from __future__ import annotations
 
@@ -71,6 +85,7 @@ import numpy as np
 
 from k8s_distributed_deeplearning_tpu import faults as _faults
 from k8s_distributed_deeplearning_tpu.models import generate
+from k8s_distributed_deeplearning_tpu.serve.page_pool import PagePool
 from k8s_distributed_deeplearning_tpu.serve.prefix_cache import PrefixCache
 from k8s_distributed_deeplearning_tpu.serve.request import (
     QueueFull, Request, RequestOutput)
@@ -124,13 +139,14 @@ def _sample_slots(logits: jax.Array, temps: jax.Array, top_ks: jax.Array,
 @functools.partial(jax.jit, static_argnames=("model",),
                    donate_argnames=("cache",))
 def _decode_program(model, params: PyTree, cache: PyTree, tokens: jax.Array,
-                    kv_lens: jax.Array, temps: jax.Array, top_ks: jax.Array,
-                    top_ps: jax.Array, keys: jax.Array):
-    """THE serving iteration: every slot advances one token. Free slots ride
-    along as inert rows (their writes land in slots the next admission
-    wholesale overwrites). Compiles once per (model, num_slots)."""
+                    kv_lens: jax.Array, tables: jax.Array, temps: jax.Array,
+                    top_ks: jax.Array, top_ps: jax.Array, keys: jax.Array):
+    """THE serving iteration: every slot advances one token through its
+    block table. Free slots ride along as inert rows (their tables are all
+    scratch, so their writes land in page 0 and are never attended).
+    Compiles once per (model, num_slots, max_blocks)."""
     logits, cache = generate.slot_decode_step(model, params, cache, tokens,
-                                              kv_lens)
+                                              kv_lens, block_tables=tables)
     keys, nxt = _sample_slots(logits, temps, top_ks, top_ps, keys)
     return nxt, keys, cache
 
@@ -143,98 +159,47 @@ def _leaf_name(path) -> str | None:
 
 @functools.partial(jax.jit, static_argnames=("model",),
                    donate_argnames=("cache",))
-def _chunk_program(model, params: PyTree, cache: PyTree, chunk: jax.Array):
-    """One INTERMEDIATE prefill chunk: append ``chunk`` ([1, C], all real
-    tokens — never padded, the cursor must advance exactly C) at the row
-    cache's cursor. Logits are discarded, so XLA dead-code-eliminates the
-    lm_head matmul for every chunk but the final one. One compile per C."""
-    _, cache = generate.prefill_chunk(model, params, cache, chunk)
+def _chunk_program(model, params: PyTree, cache: PyTree, chunk: jax.Array,
+                   table: jax.Array, start: jax.Array):
+    """One INTERMEDIATE prefill chunk: write ``chunk`` ([1, C], all real
+    tokens — never padded) through block table ``table`` ([1, max_blocks])
+    at absolute positions ``start + [0, C)``. Logits are discarded, so XLA
+    dead-code-eliminates the lm_head matmul for every chunk but the final
+    one. One compile per C."""
+    pos = (start + jnp.arange(chunk.shape[1], dtype=jnp.int32))[None, :]
+    _, cache = generate.prefill_chunk(model, params, cache, chunk,
+                                      positions=pos, block_tables=table)
     return cache
 
 
 @functools.partial(jax.jit, static_argnames=("model",),
                    donate_argnames=("cache",))
 def _final_chunk_program(model, params: PyTree, cache: PyTree,
-                         chunk: jax.Array, start: jax.Array,
-                         length: jax.Array, temp: jax.Array,
-                         top_k: jax.Array, top_p: jax.Array, key: jax.Array):
-    """Finish a prefill: run ``chunk`` ([1, bucket]) at cache position
-    ``start`` and sample the first token from the last real column
-    ``length - 1`` (both traced operands — one compile per bucket, not per
-    prompt length). With an empty starting cache, ``start=0`` and a
-    right-padded prompt this IS the whole prefill (the cold path); with a
-    pre-filled cache it resumes/overlaps per the module contract above."""
+                         chunk: jax.Array, table: jax.Array,
+                         start: jax.Array, length: jax.Array,
+                         temp: jax.Array, top_k: jax.Array,
+                         top_p: jax.Array, key: jax.Array):
+    """Finish a prefill: write ``chunk`` ([1, bucket], right-padded past
+    ``length`` real tokens) at absolute positions ``start + [0, bucket)``
+    through ``table`` and sample the first token from the last real column
+    ``length - 1`` (all traced operands — one compile per bucket, not per
+    prompt length). Pad positions past the table's last block land in the
+    pool's scratch page; pad garbage inside the last prompt page sits
+    beyond the cursor and is never attended."""
+    pos = (start + jnp.arange(chunk.shape[1], dtype=jnp.int32))[None, :]
     logits, cache = generate.prefill_chunk(model, params, cache, chunk,
-                                           start=start)
+                                           positions=pos, block_tables=table)
     last = jax.lax.dynamic_slice_in_dim(logits, length - 1, 1, axis=1)[:, 0, :]
     new_key, tok = _sample_slots(last, temp[None], top_k[None], top_p[None],
                                  key[None])
     return tok[0], new_key[0], cache
 
 
-@functools.partial(jax.jit, donate_argnames=("arena",))
-def _splice_program(arena: PyTree, pre: PyTree, slot: jax.Array) -> PyTree:
-    """Splice a single-request prefill cache into arena slot ``slot`` (a
-    traced scalar — one compile per bucket). The slot axis of each leaf is
-    the axis where the prefill cache is size 1 and the arena isn't —
-    covers both the unrolled [B, S, F] and layer-scanned [L, B, S, F]
-    cache layouts. Shape-equal leaves (the scalar shared cursor, unused in
-    slot mode) keep the arena's value."""
-    def leaf(a, p):
-        if a.shape == p.shape:
-            return a
-        for i, (ps, as_) in enumerate(zip(p.shape, a.shape)):
-            if ps == 1 and as_ != 1:
-                return jax.lax.dynamic_update_slice_in_dim(
-                    a, p.astype(a.dtype), slot, axis=i)
-        raise ValueError(
-            f"cannot locate slot axis: arena leaf {a.shape} vs prefill leaf "
-            f"{p.shape}")
-    return jax.tree.map(leaf, arena, pre)
-
-
-@functools.partial(jax.jit, donate_argnames=("cache",))
-def _paste_program(cache: PyTree, segs: list, start: jax.Array) -> PyTree:
-    """Paste ONE cached block's KV slivers (``segs``: the cached_key /
-    cached_value slices in cache-flatten order, seq dim = block) into a
-    single-row prefill cache at position ``start`` (traced — one compile
-    total) and advance the shared cursor to ``start + block`` so a
-    subsequent chunk resumes right after the pasted prefix."""
-    block = segs[0].shape[-2]
-    it = iter(segs)
-
-    def leaf(path, a):
-        name = _leaf_name(path)
-        if name in ("cached_key", "cached_value"):
-            seg = next(it)
-            return jax.lax.dynamic_update_slice_in_dim(
-                a, seg.astype(a.dtype), start, axis=a.ndim - 2)
-        if name == "cache_index":
-            return jnp.full(a.shape, start + block, a.dtype)
-        return a
-
-    return jax.tree_util.tree_map_with_path(leaf, cache)
-
-
-@functools.partial(jax.jit, static_argnames=("block",))
-def _copyout_program(cache: PyTree, start: jax.Array, *, block: int) -> list:
-    """Slice one ``block``-token KV segment out of a completed prefill
-    cache (cached_key/cached_value leaves, flatten order — the inverse of
-    :func:`_paste_program`). NOT donated: the same cache is sliced once
-    per new trie block and then spliced into the arena."""
-    out = []
-    for path, a in jax.tree_util.tree_flatten_with_path(cache)[0]:
-        if _leaf_name(path) in ("cached_key", "cached_value"):
-            out.append(jax.lax.dynamic_slice_in_dim(a, start, block,
-                                                    axis=a.ndim - 2))
-    return out
-
-
 class _InFlight:
     """Host-side record for the request occupying a slot."""
 
     __slots__ = ("req", "tokens", "t_submit", "t_admit", "t_first",
-                 "cached_prompt_tokens", "prefill_chunks")
+                 "cached_prompt_tokens", "prefill_chunks", "grow_left")
 
     def __init__(self, req: Request, first_token: int, t_admit: float):
         self.req = req
@@ -244,33 +209,39 @@ class _InFlight:
         self.t_first = t_admit
         self.cached_prompt_tokens = 0
         self.prefill_chunks = 0
+        self.grow_left = 0       # reserved-but-unallocated decode pages
+
+    def __repr__(self):
+        return (f"_InFlight({self.req.request_id}, "
+                f"tokens={len(self.tokens)})")
 
 
 class _PendingPrefill:
     """Host-side record for a slot whose prompt is still being prefilled
     (reserved: not decodable yet, not admittable either). ``pos`` is the
-    prefill cursor — prompt tokens [0, pos) are already in ``cache``
-    (pasted prefix + completed chunks); ``nodes`` pins the trie segments
-    backing the pasted region until the splice lands."""
+    prefill cursor — prompt tokens [0, pos) are already in the slot's
+    pages (mapped prefix + completed chunks); ``nodes`` pins the trie
+    segments backing the mapped region until admission completes;
+    ``grow`` is the slot's reserved decode-growth page count."""
 
-    __slots__ = ("req", "prompt", "n", "cache", "pos", "hit_tokens",
-                 "nodes", "t_pop", "chunks")
+    __slots__ = ("req", "prompt", "n", "pos", "hit_tokens", "nodes",
+                 "t_pop", "chunks", "grow")
 
-    def __init__(self, req: Request, prompt: np.ndarray, cache: PyTree,
-                 pos: int, hit_tokens: int, nodes: list, t_pop: float):
+    def __init__(self, req: Request, prompt: np.ndarray, pos: int,
+                 hit_tokens: int, nodes: list, t_pop: float, grow: int):
         self.req = req
         self.prompt = prompt
         self.n = int(prompt.shape[0])
-        self.cache = cache
         self.pos = pos
         self.hit_tokens = hit_tokens
         self.nodes = nodes
         self.t_pop = t_pop
         self.chunks = 0        # compiled prefill program runs so far
+        self.grow = grow
 
 
 class ServeEngine:
-    """Synchronous continuous-batching engine over a slot arena.
+    """Synchronous continuous-batching engine over a paged KV pool.
 
     Usage::
 
@@ -281,14 +252,25 @@ class ServeEngine:
 
     or drive iteration-by-iteration with :meth:`step` (each call = one
     decode iteration preceded by bounded admission/prefill work) and stream
-    tokens via ``Request.on_token``. ``num_slots >= 2`` (a 1-slot arena is
-    not batched serving, and slot-axis splicing needs a distinguishable
-    batch axis).
+    tokens via ``Request.on_token``. ``num_slots >= 2`` (a 1-slot batch is
+    not batched serving).
+
+    ``kv_pool_pages`` (None = ``num_slots * max_blocks``, the dense-arena
+    equivalent) sizes the shared KV page pool. Because HBM is paid per
+    allocated page, an explicit smaller pool lets MORE slots run
+    concurrently than a dense arena of the same byte budget whenever mean
+    request length is below ``max_seq_len`` — admission defers (scheduler
+    back-pressure, no crash) when free pages can't cover a request's
+    worst-case need.
 
     ``prefix_cache_mb`` (None/0 = off) bounds the rank-local prefix-reuse
-    trie; ``prefill_chunk_tokens`` (None = off) bounds each iteration's
-    prefill work to that many real prompt tokens (must be a positive
-    multiple of ``min_bucket``, the prefill bucket granularity).
+    trie, which shares pages out of the SAME pool (a trie-cached block is
+    one refcounted page, mapped — not copied — into slots that hit it);
+    ``prefill_chunk_tokens`` (None = off) bounds each iteration's prefill
+    work to that many real prompt tokens (must be a positive multiple of
+    ``min_bucket``, the prefill bucket granularity).
+    ``prefix_block_tokens`` sets the pool's page size (default
+    ``min_bucket``) — trie block and pool page are ONE granularity.
 
     ``tenants`` (optional) configures the SLO-aware multi-tenant
     scheduler (serve/sched): per-tenant EDF queues drained by
@@ -305,6 +287,7 @@ class ServeEngine:
                  prefill_chunk_tokens: int | None = None,
                  prefix_cache_mb: float | None = None,
                  prefix_block_tokens: int | None = None,
+                 kv_pool_pages: int | None = None,
                  tenants: Iterable[TenantConfig] | None = None,
                  stats: ServingStats | None = None,
                  tracer: Tracer | None = None,
@@ -315,8 +298,8 @@ class ServeEngine:
         cfg = getattr(model, "cfg", None)
         max_seq = getattr(cfg, "max_seq_len", None)
         if max_seq is None:
-            raise ValueError("model.cfg.max_seq_len is required — it sizes "
-                             "the KV arena")
+            raise ValueError("model.cfg.max_seq_len is required — it bounds "
+                             "each slot's block table")
         if prefill_chunk_tokens is not None and (
                 prefill_chunk_tokens < min_bucket
                 or prefill_chunk_tokens % min_bucket):
@@ -343,9 +326,9 @@ class ServeEngine:
         self.prefill_chunk_tokens = prefill_chunk_tokens
         self.stats = stats if stats is not None else ServingStats()
         # Spans: "admission" (queue pop -> pending created, wrapping the
-        # prefix lookup + paste), "prefill" (one compiled chunk / final
-        # chunk + splice) and "decode" (one arena-wide decode iteration
-        # incl. the host sync).
+        # prefix lookup + page mapping), "prefill" (one compiled chunk /
+        # final chunk) and "decode" (one pool-wide decode iteration incl.
+        # the host sync).
         self.tracer = tracer if tracer is not None else _NULL_TRACER
         # End-to-end lifecycle traces (graftscope): each terminal path
         # funnels through _emit_request_trace, which emits one sampled
@@ -356,57 +339,86 @@ class ServeEngine:
         self.request_log = (request_log if request_log is not None
                             else self.tracer.logger)
         self.queue = TenantScheduler(tenants, default_max_queue=max_queue)
+        # Page geometry: the trie's block size IS the pool's page size
+        # (one trie node = one page), and it applies whether or not the
+        # prefix cache is enabled.
+        bt = (prefix_block_tokens if prefix_block_tokens is not None
+              else min_bucket)
+        if bt < 1 or bt > self.max_seq_len:
+            raise ValueError(
+                f"prefix_block_tokens ({bt}) must be in "
+                f"[1, max_seq_len={self.max_seq_len}]")
+        self.page_tokens = int(bt)
+        self.max_blocks = -(-self.max_seq_len // self.page_tokens)
+        usable = (int(kv_pool_pages) if kv_pool_pages is not None
+                  else num_slots * self.max_blocks)
+        if usable < 1:
+            raise ValueError(
+                f"kv_pool_pages must be >= 1, got {kv_pool_pages}")
+        # +1: page 0 is the scratch page (see serve/page_pool.py).
+        self.pool = PagePool(usable + 1, self.page_tokens)
         # Per-slot register file (host numpy; fixed dtypes so the decode
         # program's operand signature — and thus its compilation — never
-        # changes). kv_lens doubles as the next write position.
+        # changes). kv_lens doubles as the next write position; _tables
+        # rows default to all-scratch (page 0).
         self._tokens = np.full(num_slots, pad_id, np.int32)
         self._kv_lens = np.zeros(num_slots, np.int32)
+        self._tables = np.zeros((num_slots, self.max_blocks), np.int32)
         self._temps = np.zeros(num_slots, np.float32)
         self._top_ks = np.zeros(num_slots, np.int32)
         self._top_ps = np.ones(num_slots, np.float32)
         self._keys = np.zeros((num_slots, 2), np.uint32)
         self._slots: list[_InFlight | None] = [None] * num_slots
         self._pending: dict[int, _PendingPrefill] = {}
-        self._cache = self._init_arena()
-        # Single-request row-cache template (eval_shape: no FLOPs) — each
-        # admission materializes a fresh one to fill from pasted prefix +
-        # chunks. cached_seg MUST init to ones: the shared-cursor decode
-        # branch's safety-net mask hides columns whose seg id is 0, which
-        # on a zero-filled cache would hide the entire written prefix.
+        # Single-row cache SHAPES (eval_shape: no FLOPs) — the leaf
+        # structure the pool is derived from, and the byte source for
+        # _block_nbytes.
         dummy = jnp.zeros((1, 1), jnp.int32)
         _, self._row_shapes = jax.eval_shape(
             lambda p, t: generate.prefill(self.model, p, t),
             self.params, dummy)
+        self._cache = self._init_pool_cache()
         self.prefix_cache: PrefixCache | None = None
         if prefix_cache_mb is not None and prefix_cache_mb > 0:
-            bt = (prefix_block_tokens if prefix_block_tokens is not None
-                  else min_bucket)
-            if bt < 1 or bt > self.max_seq_len:
-                raise ValueError(
-                    f"prefix_block_tokens ({bt}) must be in "
-                    f"[1, max_seq_len={self.max_seq_len}]")
             self.prefix_cache = PrefixCache(
-                int(prefix_cache_mb * 2 ** 20), block_tokens=bt,
-                block_nbytes=self._block_nbytes(bt))
+                int(prefix_cache_mb * 2 ** 20), block_tokens=self.page_tokens,
+                block_nbytes=self._block_nbytes(self.page_tokens),
+                release_page=self.pool.deref)
         # Per-step accounting for the chunked-prefill work bound (tested:
         # real prefill tokens per iteration never exceed the chunk budget).
         self.last_step_prefill_tokens = 0
         self._step_prefill_budget: int | None = None
+        self._record_pool_gauges()
 
-    def _init_arena(self) -> PyTree:
-        """Zero-filled arena with the exact leaf structure a prefill
-        produces (eval_shape: no FLOPs, no allocation). KV content is
-        irrelevant — nothing is attended until a splice installs it."""
-        dummy = jnp.zeros((self.num_slots, 1), jnp.int32)
-        _, shapes = jax.eval_shape(
-            lambda p, t: generate.prefill(self.model, p, t),
-            self.params, dummy)
-        return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes)
+    def _init_pool_cache(self) -> PyTree:
+        """Zero-filled page pool with the cache-leaf structure a prefill
+        produces, keeping ONLY cached_key/cached_value (the paged decode
+        branch declares nothing else) and reshaping each leaf's
+        [..., 1, max_seq, F] row layout to [..., num_pages, page_tokens, F].
+        KV content is irrelevant — nothing is attended until a table maps
+        a written page."""
+        bt, pages = self.page_tokens, self.pool.num_pages
+
+        def build(tree):
+            out = {}
+            for name, v in tree.items():
+                if isinstance(v, (dict,)) or hasattr(v, "items"):
+                    sub = build(v)
+                    if sub:
+                        out[name] = sub
+                elif name in ("cached_key", "cached_value"):
+                    # [1, S, F] -> [P, bt, F]; scanned [L, 1, S, F] ->
+                    # [L, P, bt, F] (batch dim 1 at -3 dropped).
+                    shape = v.shape[:-3] + (pages, bt) + v.shape[-1:]
+                    out[name] = jnp.zeros(shape, v.dtype)
+            return out
+
+        return build(self._row_shapes)
 
     def _block_nbytes(self, block_tokens: int) -> int:
-        """Bytes of KV one trie block owns (seq dim of every cached_key/
-        cached_value leaf cut to block_tokens) — lets the prefix cache
-        answer "would this block fit" before any device copy."""
+        """Bytes of KV one pool page holds (seq dim of every cached_key/
+        cached_value leaf cut to block_tokens) — the trie's exact per-node
+        cost, known without touching device arrays."""
         total = 0
         for path, s in jax.tree_util.tree_flatten_with_path(
                 self._row_shapes)[0]:
@@ -415,12 +427,14 @@ class ServeEngine:
                 total += per_pos * block_tokens * s.dtype.itemsize
         return total
 
-    def _fresh_row_cache(self) -> PyTree:
-        def leaf(path, s):
-            if _leaf_name(path) == "cached_seg":
-                return jnp.ones(s.shape, s.dtype)
-            return jnp.zeros(s.shape, s.dtype)
-        return jax.tree_util.tree_map_with_path(leaf, self._row_shapes)
+    def _need_pages(self, req: Request) -> int:
+        """Worst-case pool pages a request needs: every position it can
+        ever write — prompt [0, n) plus decode growth [n, n+max_new-1)
+        (the final sampled token is returned, never written). Conservative
+        on purpose: no prefix-hit credit, because the admission probe runs
+        BEFORE the trie lookup pins anything."""
+        total = len(req.prompt) + req.max_new_tokens - 1
+        return -(-total // self.page_tokens)
 
     # ---------------------------------------------------------------- API
 
@@ -438,8 +452,14 @@ class ServeEngine:
         if n + req.max_new_tokens > self.max_seq_len:
             raise ValueError(
                 f"prompt ({n}) + max_new_tokens ({req.max_new_tokens}) "
-                f"exceeds max_seq_len ({self.max_seq_len}) — the slot's KV "
-                "region would overflow")
+                f"exceeds max_seq_len ({self.max_seq_len}) — the slot's "
+                "block table would overflow")
+        need = self._need_pages(req)
+        if need > self.pool.num_pages - 1:
+            raise ValueError(
+                f"request needs {need} KV pages but the pool only has "
+                f"{self.pool.num_pages - 1} — raise kv_pool_pages or "
+                "lower max_new_tokens")
         req._t_submit = time.perf_counter()
         req._finished = False        # re-arm the exactly-once on_finish latch
         self.queue.submit(req)
@@ -454,21 +474,21 @@ class ServeEngine:
                     or any(s is not None for s in self._slots))
 
     def step(self) -> list[RequestOutput]:
-        """One serving iteration: admit queued requests into free slots,
-        run at most ``prefill_chunk_tokens`` real tokens of prefill work
-        (unlimited when chunking is off), then advance every occupied slot
-        one token. Returns the requests that finished during this
-        iteration (possibly at admission, when the first token is already
-        EOS or ``max_new_tokens == 1``).
+        """One serving iteration: admit queued requests into free slots
+        (page-budget permitting), run at most ``prefill_chunk_tokens``
+        real tokens of prefill work (unlimited when chunking is off),
+        then advance every occupied slot one token. Returns the requests
+        that finished during this iteration (possibly at admission, when
+        the first token is already EOS or ``max_new_tokens == 1``).
 
         Deadline enforcement happens here, at the decode boundary: an
         occupied or mid-prefill slot whose request's ``deadline_s`` has
-        expired is cancelled FIRST (finish_reason "timeout", slot freed —
-        so the admission pass below can reuse it this very iteration), and
-        an expired request popped from the queue completes as "timeout"
-        without ever prefilling. A hung client therefore costs at most
-        one decode iteration of slot time past its own budget, and never
-        stalls the other slots."""
+        expired is cancelled FIRST (finish_reason "timeout", slot and
+        pages freed — so the admission pass below can reuse both this
+        very iteration), and an expired request popped from the queue
+        completes as "timeout" without ever prefilling. A hung client
+        therefore costs at most one decode iteration of slot time past
+        its own budget, and never stalls the other slots."""
         outputs: list[RequestOutput] = []
         now = time.perf_counter()
         for slot, fl in enumerate(self._slots):
@@ -486,8 +506,8 @@ class ServeEngine:
         self._step_prefill_budget = self.prefill_chunk_tokens
         # Admission and prefill alternate until neither makes progress:
         # a request that finishes AT admission (first token is EOS /
-        # max_new_tokens == 1) frees its slot for the next queued request
-        # within the same iteration, budget permitting.
+        # max_new_tokens == 1) frees its slot AND its pages for the next
+        # queued request within the same iteration, budget permitting.
         while True:
             self._admit_free_slots(outputs)
             freed = self._run_prefills(outputs)
@@ -495,15 +515,27 @@ class ServeEngine:
                 break
         active = sum(s is not None for s in self._slots)
         if active == 0:
+            self._record_pool_gauges()
             return outputs
+        # Decode-growth pages: a slot whose next write position crosses
+        # into an unmapped block claims one of ITS reserved pages —
+        # infallible by construction (reserved at admission), so growth
+        # can never be starved by other admissions.
+        for slot, fl in enumerate(self._slots):
+            if fl is None:
+                continue
+            blk = int(self._kv_lens[slot]) // self.page_tokens
+            if self._tables[slot, blk] == 0:
+                self._tables[slot, blk] = self.pool.alloc_reserved(1)[0]
+                fl.grow_left -= 1
         inj = _faults.active()
         if inj is not None:
             inj.fire("serve_decode")
         with self.tracer.span("decode", active=active):
             nxt, keys, self._cache = _decode_program(
                 self.model, self.params, self._cache, self._tokens,
-                self._kv_lens, self._temps, self._top_ks, self._top_ps,
-                self._keys)
+                self._kv_lens, self._tables, self._temps, self._top_ks,
+                self._top_ps, self._keys)
             # graftlint: disable=host-sync — the iteration's one honest
             # sync: every slot's sampled token in a single device fence.
             nxt = np.asarray(nxt)
@@ -528,6 +560,7 @@ class ServeEngine:
                 outputs.append(self._finish(slot, "eos"))
             elif len(fl.tokens) >= fl.req.max_new_tokens:
                 outputs.append(self._finish(slot, "length"))
+        self._record_pool_gauges()
         return outputs
 
     def run(self, requests: Iterable[Request] | None = None,
@@ -565,9 +598,9 @@ class ServeEngine:
 
     def shutdown(self) -> list[RequestOutput]:
         """Abort everything: queued requests (no tokens), mid-prefill
-        requests (pinned trie segments released) and in-flight requests
-        (partial tokens) all complete with finish_reason "aborted". The
-        engine is reusable afterwards."""
+        requests (pinned trie segments released, pages freed) and
+        in-flight requests (partial tokens) all complete with
+        finish_reason "aborted". The engine is reusable afterwards."""
         outs: list[RequestOutput] = []
         now = time.perf_counter()
         for req in self.queue.drain():
@@ -622,6 +655,11 @@ class ServeEngine:
         req._finished = True
         if req.on_finish is not None:
             req.on_finish(reason)
+
+    def _record_pool_gauges(self) -> None:
+        c = self.pool.counters()
+        self.stats.record_kv_pool(c["pages_total"], c["pages_used"],
+                                  c["pages_shared"])
 
     def _timeout_unadmitted(self, req: Request) -> RequestOutput:
         """Terminal output for a request whose deadline expired while it
@@ -683,15 +721,30 @@ class ServeEngine:
             b *= 2
         return min(b, self.max_seq_len)
 
+    def _fits(self, req: Request) -> bool:
+        """Admission-time page probe (the scheduler calls this on its
+        chosen head before popping): can the pool cover the request's
+        worst-case need right now? Trie-only pages are reclaimable — evict
+        unpinned LRU leaves until the request fits or the trie runs dry.
+        False defers the request in place: no pop, no starvation (pages
+        free monotonically as running slots finish)."""
+        need = self._need_pages(req)
+        while self.pool.available() < need:
+            if (self.prefix_cache is None
+                    or not self.prefix_cache.evict_lru_unpinned()):
+                return False
+        return True
+
     def _admit_free_slots(self, outputs: list[RequestOutput]) -> None:
         """Pop queued requests into free, non-pending slots (expired ones
         complete as "timeout" without costing prefill). ``pop() -> None``
-        with a non-empty queue means every queued tenant is rate- or
-        quota-blocked right now — no slot will do better, so stop."""
+        with a non-empty queue means every queued tenant is rate-,
+        quota- or PAGE-blocked right now — no slot will do better, so
+        stop."""
         for slot in range(self.num_slots):
             while (self._slots[slot] is None and slot not in self._pending
                    and len(self.queue)):
-                req = self.queue.pop()
+                req = self.queue.pop(fits=self._fits)
                 if req is None:
                     return
                 if self._expired(req, time.perf_counter()):
@@ -702,35 +755,49 @@ class ServeEngine:
                 break
 
     def _begin_admission(self, slot: int, req: Request) -> None:
-        """Reserve *slot* for *req*: build its row cache, paste the longest
-        trie-cached prefix (pinning the matched segments), and park it as a
-        pending prefill for :meth:`_run_prefills` to advance."""
+        """Reserve *slot* for *req*: map the longest trie-cached prefix
+        into its block table (ZERO device copies — each matched node's
+        page is ref'd and written into the table), allocate private pages
+        for the uncached prompt tail, reserve worst-case decode growth,
+        and park it as a pending prefill for :meth:`_run_prefills`.
+        Allocation cannot fail here: the scheduler's ``fits`` probe
+        guaranteed the (hit-blind, hence conservative) need before the
+        pop, and nothing else allocates in between."""
         n = len(req.prompt)
         t_pop = time.perf_counter()
         prompt = np.asarray(req.prompt, np.int32)
+        bt = self.page_tokens
         hit, nodes = 0, []
         with self.tracer.span("admission", prompt_len=n, slot=slot):
-            cache = self._fresh_row_cache()
             if self.prefix_cache is not None:
                 hit, nodes = self.prefix_cache.acquire(prompt.tolist())
                 self.stats.record_prefix_lookup(hit, n)
-                bt = self.prefix_cache.block_tokens
                 for j, node in enumerate(nodes):
-                    cache = _paste_program(cache, node.kv, np.int32(j * bt))
-        self._pending[slot] = _PendingPrefill(req, prompt, cache, hit, hit,
-                                              nodes, t_pop)
+                    self.pool.ref(node.page)
+                    self._tables[slot, j] = node.page
+            n_prompt_blocks = -(-n // bt)
+            priv = self.pool.alloc(n_prompt_blocks - hit // bt)
+            self._tables[slot, hit // bt:n_prompt_blocks] = priv
+            grow = (-(-(n + req.max_new_tokens - 1) // bt)
+                    - n_prompt_blocks)
+            self.pool.reserve(grow)
+        self._pending[slot] = _PendingPrefill(req, prompt, hit, hit, nodes,
+                                              t_pop, grow)
         t0 = req._t_submit if req._t_submit is not None else t_pop
         self.stats.record_admission(queue_s=t_pop - t0, prompt_len=n)
 
     def _run_prefills(self, outputs: list[RequestOutput]) -> bool:
         """Advance pending prefills FIFO within this step's token budget.
         Intermediate chunks are exact C-token slices; the final chunk
-        (bucketed) completes the admission. Returns True when a request
+        (bucketed) completes the admission. All chunks write straight into
+        the slot's pool pages through its block table — there is no
+        intermediate row cache and no splice. Returns True when a request
         finished AT admission and freed its slot."""
         freed = False
         for slot in list(self._pending):
             pend = self._pending.get(slot)
             c = self.prefill_chunk_tokens
+            table = self._tables[slot:slot + 1]
             while pend is not None:
                 rem = pend.n - pend.pos
                 budget = self._step_prefill_budget
@@ -739,9 +806,11 @@ class ServeEngine:
                         break       # out of budget; resume next iteration
                     chunk = pend.prompt[None, pend.pos:pend.pos + c]
                     with self.tracer.span("prefill", chunk=c, slot=slot):
-                        pend.cache = _chunk_program(
-                            self.model, self.params, pend.cache,
-                            np.ascontiguousarray(chunk))
+                        self._cache = _chunk_program(
+                            self.model, self.params, self._cache,
+                            np.ascontiguousarray(chunk),
+                            np.ascontiguousarray(table),
+                            np.int32(pend.pos))
                     pend.pos += c
                     pend.chunks += 1
                     self._charge_prefill(c)
@@ -764,52 +833,46 @@ class ServeEngine:
 
     def _finish_admission(self, slot: int,
                           pend: _PendingPrefill) -> RequestOutput | None:
-        """Run the final (sampling) chunk, insert the prompt's KV into the
-        trie, splice the row cache into the arena and activate the slot.
-        Returns a RequestOutput when the request finished at admission
-        (first token was EOS, or the length budget is a single token) —
-        the slot stays free in that case."""
+        """Run the final (sampling) chunk, adopt the prompt's pages into
+        the trie, and activate the slot. The chunk resumes at the prefill
+        cursor RIGHT-PADDED to the bucket — the paged scatter writes each
+        token at its absolute position, so the pad tail lands beyond the
+        cursor (never attended) or in the scratch page (beyond the
+        table), and positions before the cursor — including trie-shared
+        pages — are never touched. Returns a RequestOutput when the
+        request finished at admission (first token was EOS, or the length
+        budget is a single token) — the slot stays free in that case."""
         req, n = pend.req, pend.n
         rem = n - pend.pos
         bucket = self._bucket(rem)
         sp = req.sampling
-        if n >= bucket:
-            # All-real tail: re-feed the last `bucket` prompt tokens with
-            # the cursor rewound to n - bucket. The overlapped positions
-            # rewrite KV bit-identical to what's already there (same
-            # tokens, same absolute positions) — never writes past n, so
-            # dynamic_update_slice can't clamp-misalign.
-            start = n - bucket
-            chunk = np.ascontiguousarray(pend.prompt[None, start:])
-            last = bucket
-        else:
-            # Short prompt (shorter than the smallest bucket that fits its
-            # remainder): right-pad from position 0 — the cold path.
-            start = 0
-            chunk = np.full((1, bucket), self.pad_id, np.int32)
-            chunk[0, :n] = pend.prompt
-            last = n
+        chunk = np.full((1, bucket), self.pad_id, np.int32)
+        chunk[0, :rem] = pend.prompt[pend.pos:]
+        table = self._tables[slot:slot + 1]
         with self.tracer.span("prefill", bucket=bucket, slot=slot,
                               cached=pend.hit_tokens):
-            tok, key, pre = _final_chunk_program(
-                self.model, self.params, pend.cache, chunk, np.int32(start),
-                np.int32(last), np.float32(sp.temperature),
+            tok, key, self._cache = _final_chunk_program(
+                self.model, self.params, self._cache, chunk,
+                np.ascontiguousarray(table), np.int32(pend.pos),
+                np.int32(rem), np.float32(sp.temperature),
                 np.int32(sp.top_k), np.float32(sp.top_p),
                 np.asarray(jax.random.PRNGKey(req.seed), np.uint32))
             if self.prefix_cache is not None:
-                # Insert BEFORE the splice: _splice_program donates `pre`.
-                # Copy-out runs only for blocks the trie doesn't hold (and
-                # never when the budget can't fit a block).
-                bt = self.prefix_cache.block_tokens
+                # Adopt whole prompt blocks into the trie by REFERENCE:
+                # the trie takes its own refcount on the slot's page, so
+                # the KV survives the slot and later requests map it with
+                # zero copies. Runs only for blocks the trie doesn't hold.
+                def page_for_block(i: int) -> int:
+                    page = int(self._tables[slot, i])
+                    self.pool.ref(page)
+                    return page
+
                 _, evicted = self.prefix_cache.insert(
-                    pend.prompt.tolist(),
-                    lambda i: _copyout_program(pre, np.int32(i * bt),
-                                               block=bt))
+                    pend.prompt.tolist(), page_for_block)
                 if evicted:
                     self.stats.record_prefix_evictions(evicted)
                 self.prefix_cache.release(pend.nodes)
                 pend.nodes = []
-            self._cache = _splice_program(self._cache, pre, np.int32(slot))
             first = int(tok)
         del self._pending[slot]
         now = time.perf_counter()
@@ -817,6 +880,7 @@ class ServeEngine:
         fl.t_admit = pend.t_pop
         fl.cached_prompt_tokens = pend.hit_tokens
         fl.prefill_chunks = pend.chunks + 1     # + the final sampling chunk
+        fl.grow_left = pend.grow
         self._slots[slot] = fl
         self._tokens[slot] = first
         self._kv_lens[slot] = n          # next write position
@@ -833,13 +897,27 @@ class ServeEngine:
             return self._finish(slot, "length")
         return None
 
+    def _release_slot_pages(self, slot: int, grow_left: int) -> None:
+        """Terminal page bookkeeping: deref every mapped page (freeing
+        those the trie doesn't also hold), reset the table row to
+        all-scratch, and return unused growth reservation."""
+        for j in range(self.max_blocks):
+            page = int(self._tables[slot, j])
+            if page:
+                self.pool.deref(page)
+        self._tables[slot, :] = 0
+        if grow_left:
+            self.pool.unreserve(grow_left)
+
     def _cancel_pending(self, slot: int, reason: str) -> RequestOutput:
         """Terminal output for a request cancelled mid-prefill (deadline /
-        shutdown): release its pinned trie segments, free the slot."""
+        shutdown): release its pinned trie segments, free its pages and
+        reservation, free the slot."""
         pend = self._pending.pop(slot)
         if self.prefix_cache is not None and pend.nodes:
             self.prefix_cache.release(pend.nodes)
             pend.nodes = []
+        self._release_slot_pages(slot, pend.grow)
         now = time.perf_counter()
         t0 = (pend.req._t_submit if pend.req._t_submit is not None else now)
         out = RequestOutput(
@@ -872,6 +950,7 @@ class ServeEngine:
         self._temps[slot] = 0.0
         self._top_ks[slot] = 0
         self._top_ps[slot] = 1.0
+        self._release_slot_pages(slot, fl.grow_left)
         self.stats.record_completion(latency_s=out.latency_s,
                                      n_tokens=len(out.tokens), reason=reason)
         self.queue.release(fl.req)

@@ -1,0 +1,136 @@
+"""Refcounted page allocator for the paged KV arena (host-side bookkeeping).
+
+One :class:`PagePool` tracks every fixed-size KV page in the serving
+engine's device pool (``[num_pages, page_tokens, kv·hd]`` per cache leaf).
+Pages are shared: a decode slot holds one reference per block-table entry
+and the prefix trie holds its own reference per cached node, so a page
+backing a popular prefix can be mapped into many slots at once with ZERO
+device copies — freeing it only when the last holder lets go.
+
+Page 0 is the reserved SCRATCH page: it is never handed out, block tables
+default to it (idle slots write there harmlessly), and the model redirects
+out-of-table right-pad writes there. ``pages_total`` therefore counts
+usable pages (``num_pages - 1``).
+
+Reservations make decode growth infallible: admission reserves the slot's
+worst-case remaining pages (``max_new - 1`` tokens of growth) up front, so
+a mid-decode page-boundary allocation (:meth:`alloc_reserved`) can never
+fail — back-pressure exists only at admission, where the scheduler's
+``fits`` probe checks :meth:`available` before popping a request.
+
+Pure Python/NumPy over small arrays — no device traffic; the device pool
+itself lives in the engine's cache pytree.
+"""
+from __future__ import annotations
+
+__all__ = ["PagePool"]
+
+import numpy as np
+
+
+class PagePool:
+    """Free-list + refcount bookkeeping over ``num_pages`` KV pages.
+
+    Invariants (checked cheaply where they guard corruption):
+      - page 0 is scratch: never allocated, never freed, refs pinned at 1;
+      - a page is in ``_free`` iff its refcount is 0;
+      - ``reserved`` pages are free pages promised to admitted slots —
+        :meth:`available` excludes them so admission cannot oversubscribe
+        the growth headroom of slots already running.
+    """
+
+    def __init__(self, num_pages: int, page_tokens: int):
+        if num_pages < 2:
+            raise ValueError(
+                f"PagePool needs >= 2 pages (scratch + 1 usable), got "
+                f"{num_pages}")
+        if page_tokens < 1:
+            raise ValueError(f"page_tokens must be >= 1, got {page_tokens}")
+        self.num_pages = int(num_pages)
+        self.page_tokens = int(page_tokens)
+        self._refs = np.zeros(self.num_pages, np.int32)
+        self._refs[0] = 1          # scratch: pinned forever
+        # LIFO free list: recently-freed pages are re-issued first (their
+        # device lines are most likely still resident).
+        self._free = list(range(self.num_pages - 1, 0, -1))
+        self.reserved = 0
+
+    # ---- allocation ------------------------------------------------------
+
+    # graftlint: hot-path
+    def alloc(self, n: int) -> list[int]:
+        """Pop ``n`` fresh pages (refcount 1 each). Raises ``RuntimeError``
+        on exhaustion — callers gate on :meth:`available` first (the
+        scheduler's ``fits`` probe), so hitting this means an accounting
+        bug, not load."""
+        if n > len(self._free) - self.reserved:
+            raise RuntimeError(
+                f"page pool exhausted: want {n}, have "
+                f"{len(self._free) - self.reserved} unreserved free pages "
+                f"(admission must gate on available())")
+        pages = [self._free.pop() for _ in range(n)]
+        self._refs[pages] = 1
+        return pages
+
+    def alloc_reserved(self, n: int) -> list[int]:
+        """Pop ``n`` pages against an existing reservation (decode growth).
+        Infallible by construction: admission reserved these pages."""
+        if n > self.reserved:
+            raise RuntimeError(
+                f"alloc_reserved({n}) exceeds outstanding reservation "
+                f"({self.reserved}) — growth accounting bug")
+        self.reserved -= n
+        pages = [self._free.pop() for _ in range(n)]
+        self._refs[pages] = 1
+        return pages
+
+    # ---- refcounts -------------------------------------------------------
+
+    def ref(self, page: int) -> None:
+        """Add a reference to an already-live page (trie hit mapped into a
+        slot's table, or a freshly-prefilled block adopted by the trie)."""
+        if page <= 0 or self._refs[page] == 0:
+            raise RuntimeError(f"ref() on dead or scratch page {page}")
+        self._refs[page] += 1
+
+    def deref(self, page: int) -> None:
+        """Drop a reference; the page returns to the free list at zero."""
+        if page <= 0 or self._refs[page] == 0:
+            raise RuntimeError(f"deref() on dead or scratch page {page}")
+        self._refs[page] -= 1
+        if self._refs[page] == 0:
+            self._free.append(page)
+
+    # ---- reservations ----------------------------------------------------
+
+    def reserve(self, n: int) -> None:
+        """Promise ``n`` free pages to a slot's future decode growth."""
+        if n > len(self._free) - self.reserved:
+            raise RuntimeError(
+                f"cannot reserve {n} pages: only "
+                f"{len(self._free) - self.reserved} unreserved free")
+        self.reserved += n
+
+    def unreserve(self, n: int) -> None:
+        """Return unused growth headroom (request finished early)."""
+        if n > self.reserved:
+            raise RuntimeError(
+                f"unreserve({n}) exceeds outstanding reservation "
+                f"({self.reserved})")
+        self.reserved -= n
+
+    # ---- introspection ---------------------------------------------------
+
+    def available(self) -> int:
+        """Pages an admission may claim right now (free minus reserved)."""
+        return len(self._free) - self.reserved
+
+    def counters(self) -> dict:
+        """Utilization snapshot (scratch page excluded throughout)."""
+        used = int(np.count_nonzero(self._refs[1:]))
+        return {
+            "pages_total": self.num_pages - 1,
+            "pages_used": used,
+            "pages_shared": int(np.count_nonzero(self._refs[1:] >= 2)),
+            "pages_reserved": self.reserved,
+        }

@@ -1,6 +1,6 @@
-"""Prefix-reuse KV cache: a token-block trie with LRU eviction under a
-byte budget (SGLang's RadixAttention idea, restricted to fixed-size blocks
-so every cached segment splices with ONE compiled paste program).
+"""Prefix-reuse KV cache: a token-block trie over PAGE IDS with LRU
+eviction under a byte budget (SGLang's RadixAttention idea, fused with the
+engine's paged KV pool so a hit costs zero device copies).
 
 Real serving traffic shares prompt prefixes — a fleet-wide system prompt,
 few-shot templates, multi-turn histories — and the engine used to burn
@@ -9,74 +9,91 @@ memoizes prompt KV **rank-locally** at block granularity:
 
 - The trie is keyed on *token blocks*: each edge is a tuple of exactly
   ``block_tokens`` token ids, so a node at depth d caches the KV for the
-  first ``d * block_tokens`` tokens of any prompt reaching it. Block
-  granularity keeps the splice/copy-out programs shape-static (one compile
-  each) and makes partial-prefix hits natural: a request matching 3 of its
-  5 blocks prefills only the tail.
-- Each node OWNS its KV segment: the ``cached_key``/``cached_value``
-  slivers (``[..., block_tokens, kv*head_dim]``, the engine's folded-head
-  decode layout) for its block's positions. Absolute positions make this
-  sound for RoPE models: position enters K at projection time, so the
-  cached K for positions [s, s+block) is reusable verbatim by any prompt
-  sharing those exact tokens at those exact offsets — which is precisely
-  what trie membership guarantees.
+  first ``d * block_tokens`` tokens of any prompt reaching it. The trie's
+  block size IS the pool's page size: one trie node = one pool page.
+- Each node holds a POOL PAGE ID, not arrays. The KV bytes live in the
+  engine's shared page pool; the trie owns one refcount on the page
+  (taken by the engine's ``page_for_block`` callback at insert). A prefix
+  hit therefore *maps* the node's page into the requesting slot's block
+  table — a host-side int copy plus a ``pool.ref`` — where the dense
+  design paid a per-block device paste. Absolute positions make the
+  sharing sound for RoPE models: position enters K at projection time, so
+  the cached K for positions [s, s+block) is reusable verbatim by any
+  prompt sharing those exact tokens at those exact offsets — which is
+  precisely what trie membership guarantees.
 - Eviction is LRU over *leaf* nodes only (evicting an interior node would
   orphan the descendants that extend its prefix) under ``capacity_bytes``.
   A node pinned by an in-flight admission (``refs > 0``) is never evicted:
-  the engine acquires the matched path at lookup and releases it after the
-  KV has been spliced into the request's prefill cache, so eviction can
-  never free a segment a pending splice still reads. Interior nodes are
-  protected transitively — they have children by definition.
+  the engine acquires the matched path at lookup and releases it once the
+  pages are mapped into the slot's table (each mapping holding its own
+  pool reference), so eviction can never unmap a page a pending admission
+  still needs. Interior nodes are protected transitively — they have
+  children by definition. Evicting a node calls ``release_page`` (the
+  engine passes ``pool.deref``): the page returns to the free list only
+  when no slot still maps it.
 
-The cache stores device arrays; byte accounting uses the arrays' nominal
-``nbytes`` (the engine passes ``block_nbytes`` so "would it fit" is
-answerable before paying the copy-out).
+Byte accounting is exact and lives in ONE place: every node costs the
+engine-computed ``block_nbytes`` (all cache leaves × block_tokens
+positions), charged at insert and refunded at evict — no per-array
+``nbytes`` summation, no fallback path. ``used_bytes`` always equals
+``sum(node.nbytes for node in trie)``.
 """
 from __future__ import annotations
 
 import itertools
-from typing import Any, Callable, Sequence
+from typing import Callable, Sequence
 
 
 class _Node:
-    """One cached block: ``key`` is its token tuple, ``kv`` the list of
-    per-leaf KV slivers (flatten order of the engine's cache pytree)."""
+    """One cached block: ``key`` is its token tuple, ``page`` the pool page
+    id holding its KV (the trie owns one pool reference on it)."""
 
-    __slots__ = ("key", "parent", "children", "kv", "nbytes", "refs",
+    __slots__ = ("key", "parent", "children", "page", "nbytes", "refs",
                  "last_used")
 
-    def __init__(self, key, parent, kv, nbytes, stamp):
+    def __init__(self, key, parent, page, nbytes, stamp):
         self.key = key
         self.parent = parent
         self.children: dict[tuple, "_Node"] = {}
-        self.kv = kv
+        self.page = page
         self.nbytes = nbytes
         self.refs = 0
         self.last_used = stamp
 
 
 class PrefixCache:
-    """Token-block trie of KV segments with refcounts and LRU eviction.
+    """Token-block trie of pool page ids with refcounts and LRU eviction.
 
     ``capacity_bytes <= 0`` still constructs (an always-empty cache — every
-    insert is rejected before any copy-out), which is how the "enabled but
-    empty" overhead gate isolates pure bookkeeping cost.
+    insert is rejected before taking a page reference), which is how the
+    "enabled but empty" overhead gate isolates pure bookkeeping cost.
+
+    ``block_nbytes`` (required, > 0) is the engine-computed byte cost of
+    one block across every cache leaf; ``release_page`` is called with a
+    node's page id when the node is evicted (the engine passes
+    ``pool.deref`` so the trie's reference is returned).
     """
 
     def __init__(self, capacity_bytes: int, block_tokens: int = 32,
-                 block_nbytes: int | None = None):
+                 block_nbytes: int | None = None,
+                 release_page: Callable[[int], None] | None = None):
         if block_tokens < 1:
             raise ValueError(
                 f"block_tokens must be >= 1, got {block_tokens}")
+        if block_nbytes is None or block_nbytes <= 0:
+            raise ValueError(
+                f"block_nbytes is required and must be > 0, got "
+                f"{block_nbytes} — the engine computes it from the cache "
+                "leaf shapes so fit tests never touch device arrays")
         self.capacity_bytes = int(capacity_bytes)
         self.block_tokens = int(block_tokens)
-        # Size of one block's KV, known up front so insert() can test fit
-        # (and skip) BEFORE paying the device copy-out for the segment.
-        self.block_nbytes = block_nbytes
+        self.block_nbytes = int(block_nbytes)
+        self.release_page = release_page or (lambda page: None)
         self.used_bytes = 0
         self._root = _Node(None, None, None, 0, -1)
         self._nodes: list[_Node] = []
         self._clock = itertools.count()
+        self._evicted_pending = 0
         # Counters (monotonic; the engine mirrors deltas into ServingStats).
         self.hits = 0                  # lookups that matched >= 1 block
         self.misses = 0                # lookups that matched nothing
@@ -102,7 +119,8 @@ class PrefixCache:
         token must always be prefilled so the engine has logits to sample
         the first output token from). Pins every matched node (``refs`` +1)
         and touches it for LRU. Returns ``(hit_tokens, pinned_nodes)``;
-        the caller MUST :meth:`release` the nodes once the KV is spliced.
+        the caller MUST :meth:`release` the nodes once their pages are
+        mapped (and individually ref'd) into the slot's block table.
         """
         limit = len(tokens) - 1 if max_tokens is None else max_tokens
         node, nodes, pos, i = self._root, [], 0, 0
@@ -131,33 +149,31 @@ class PrefixCache:
     # -------------------------------------------------------------- insert
 
     def insert(self, tokens: Sequence[int],
-               kv_for_block: Callable[[int], list[Any]]) -> tuple[int, int]:
+               page_for_block: Callable[[int], int]) -> tuple[int, int]:
         """Insert every whole block of *tokens* not already cached, calling
-        ``kv_for_block(i)`` (→ list of per-leaf slivers) only for NEW blocks
-        — already-present blocks are just LRU-touched, so re-serving a hot
-        prefix costs no device copies. Blocks that cannot fit even after
-        eviction are skipped (and the walk stops: a child without its
-        parent chain would be unreachable). Returns
-        ``(new_blocks, evicted_blocks)``.
+        ``page_for_block(i)`` (→ pool page id, with one pool reference
+        already taken for the trie) only for NEW blocks — already-present
+        blocks are just LRU-touched, so re-serving a hot prefix costs
+        nothing. The fit test (and any eviction it forces) happens BEFORE
+        the callback, so a block that can't fit never takes a reference.
+        Blocks that cannot fit even after eviction are skipped (and the
+        walk stops: a child without its parent chain would be unreachable).
+        Returns ``(new_blocks, evicted_blocks)``.
         """
         node, new = self._root, 0
         for i in range(len(tokens) // self.block_tokens):
             key = self._key(tokens, i)
             child = node.children.get(key)
             if child is None:
-                need = self.block_nbytes
-                if need is not None and not self._make_room(need):
+                if not self._make_room(self.block_nbytes):
                     self.skipped_blocks += 1
                     break
-                kv = kv_for_block(i)
-                nbytes = sum(int(a.nbytes) for a in kv)
-                if need is None and not self._make_room(nbytes):
-                    self.skipped_blocks += 1
-                    break
-                child = _Node(key, node, kv, nbytes, next(self._clock))
+                page = page_for_block(i)
+                child = _Node(key, node, page, self.block_nbytes,
+                              next(self._clock))
                 node.children[key] = child
                 self._nodes.append(child)
-                self.used_bytes += nbytes
+                self.used_bytes += self.block_nbytes
                 self.inserted_blocks += 1
                 new += 1
             else:
@@ -171,27 +187,38 @@ class PrefixCache:
         if need > self.capacity_bytes:
             return False
         while self.used_bytes + need > self.capacity_bytes:
-            victim = None
-            for nd in self._nodes:
-                if nd.children or nd.refs > 0:
-                    continue
-                if victim is None or nd.last_used < victim.last_used:
-                    victim = nd
-            if victim is None:
+            if not self.evict_lru_unpinned():
                 return False
-            self._evict(victim)
+        return True
+
+    def evict_lru_unpinned(self) -> bool:
+        """Evict the single least-recently-used unpinned LEAF, releasing
+        its page reference. False when nothing is evictable. Also the
+        engine's pool-pressure valve: when admission needs more free pages
+        than the pool has, it evicts trie-only pages one at a time until
+        the request fits or the trie runs dry."""
+        victim = None
+        for nd in self._nodes:
+            if nd.children or nd.refs > 0:
+                continue
+            if victim is None or nd.last_used < victim.last_used:
+                victim = nd
+        if victim is None:
+            return False
+        self._evict(victim)
         return True
 
     def _evict(self, node: _Node) -> None:
         del node.parent.children[node.key]
         self._nodes.remove(node)
         self.used_bytes -= node.nbytes
-        node.kv = None                  # drop the device buffers
+        self.release_page(node.page)    # trie's pool reference returned
+        node.page = None
         self.evictions += 1
-        self._evicted_pending = getattr(self, "_evicted_pending", 0) + 1
+        self._evicted_pending += 1
 
     def _drain_evicted(self) -> int:
-        n = getattr(self, "_evicted_pending", 0)
+        n = self._evicted_pending
         self._evicted_pending = 0
         return n
 

@@ -179,11 +179,19 @@ class TenantScheduler:
     # ---------------------------------------------------------------- pop
 
     # graftlint: hot-path
-    def pop(self) -> Request | None:
+    def pop(self, fits=None) -> Request | None:
         """Next admissible request under the policy, or None when every
         queued tenant is rate- or quota-blocked (or nothing is queued).
         A returned request holds one slot against its tenant's quota
-        until :meth:`release`."""
+        until :meth:`release`.
+
+        ``fits`` (optional predicate) is the engine's resource probe —
+        e.g. "does the KV page pool cover this request's worst-case
+        need". It runs on the policy's CHOSEN head BEFORE any state
+        mutates: a False verdict returns None with the request still
+        queued at its tenant's head (deficits, rate tokens and quotas
+        untouched), so admission back-pressure composes with DRR without
+        double-charging the deferred request."""
         if not self._n:
             return None
         now = self._clock()
@@ -195,6 +203,8 @@ class TenantScheduler:
             if chosen is None:
                 continue            # class fully blocked: try the next one
             ts, idx = chosen
+            if fits is not None and not fits(ts.heap[0][2]):
+                return None         # resource-blocked: defer in place
             _, _, req = heapq.heappop(ts.heap)
             self._n -= 1
             cost = _cost(req)

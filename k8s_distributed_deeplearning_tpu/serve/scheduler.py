@@ -44,8 +44,13 @@ class RequestQueue:
                 f"after completions free capacity (request {req.request_id})")
         self._q.append(req)
 
-    def pop(self) -> Request | None:
-        return self._q.popleft() if self._q else None
+    def pop(self, fits=None) -> Request | None:
+        """FCFS head, or None when empty — or when the engine's ``fits``
+        resource probe (e.g. KV page availability) rejects the head, which
+        defers it in place (same contract as TenantScheduler.pop)."""
+        if not self._q or (fits is not None and not fits(self._q[0])):
+            return None
+        return self._q.popleft()
 
     def sweep_expired(self, now: float | None = None) -> list[Request]:
         """FCFS keeps no deadline index: expired requests are detected at

@@ -135,6 +135,15 @@ def serving_collector(registry: MetricsRegistry,
         "serve_request_traces_sampled": registry.gauge(
             "serve_request_traces_sampled",
             "request_trace lifecycle events emitted (graftscope sampling)"),
+        "serve_kv_pages_total": registry.gauge(
+            "serve_kv_pages_total",
+            "usable pages in the paged KV pool (scratch excluded)"),
+        "serve_kv_pages_used": registry.gauge(
+            "serve_kv_pages_used",
+            "KV pool pages currently referenced by a slot or the trie"),
+        "serve_kv_pages_shared": registry.gauge(
+            "serve_kv_pages_shared",
+            "KV pool pages with >= 2 holders (copy-free prefix sharing)"),
     }
     key_map = {"requests_admitted": "serve_requests_admitted",
                "requests_completed": "serve_requests_completed",
@@ -150,7 +159,10 @@ def serving_collector(registry: MetricsRegistry,
                "prefix_cache_misses": "serve_prefix_cache_misses",
                "prefix_cache_evictions": "serve_prefix_cache_evictions",
                "prefix_hit_rate": "serve_prefix_hit_rate",
-               "request_traces_sampled": "serve_request_traces_sampled"}
+               "request_traces_sampled": "serve_request_traces_sampled",
+               "kv_pages_total": "serve_kv_pages_total",
+               "kv_pages_used": "serve_kv_pages_used",
+               "kv_pages_shared": "serve_kv_pages_shared"}
 
     def collect() -> None:
         summ = stats.summary()

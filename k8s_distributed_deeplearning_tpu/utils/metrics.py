@@ -121,6 +121,12 @@ class ServingStats:
         self.prefix_evictions = 0
         # Sampled end-to-end request_trace events emitted (graftscope).
         self.request_traces = 0
+        # Paged KV pool utilization gauges (latest snapshot, not rates):
+        # total usable pages, pages with >= 1 holder, pages with >= 2
+        # holders (trie+slot or multi-slot sharing — the copy-free wins).
+        self.kv_pages_total = 0
+        self.kv_pages_used = 0
+        self.kv_pages_shared = 0
 
     def _tick(self) -> None:
         now = time.perf_counter()
@@ -164,6 +170,15 @@ class ServingStats:
         """One sampled ``request_trace`` lifecycle event was emitted."""
         self._tick()
         self.request_traces += 1
+
+    def record_kv_pool(self, pages_total: int, pages_used: int,
+                       pages_shared: int) -> None:
+        """Latest paged-KV pool utilization snapshot. Deliberately NO
+        ``_tick()``: a gauge refresh is not serving activity and must not
+        stretch the elapsed window the throughput rates divide by."""
+        self.kv_pages_total = int(pages_total)
+        self.kv_pages_used = int(pages_used)
+        self.kv_pages_shared = int(pages_shared)
 
     def record_completion(self, latency_s: float, n_tokens: int,
                           reason: str) -> None:
@@ -209,6 +224,9 @@ class ServingStats:
             "prefix_cache_hits": self.prefix_hits,
             "prefix_cache_misses": self.prefix_misses,
             "prefix_cache_evictions": self.prefix_evictions,
+            "kv_pages_total": self.kv_pages_total,
+            "kv_pages_used": self.kv_pages_used,
+            "kv_pages_shared": self.kv_pages_shared,
             "request_traces_sampled": self.request_traces,
             # Fraction of looked-up prompt tokens served from cached KV
             # (None until the first lookup, i.e. cache disabled or idle).

@@ -39,6 +39,10 @@ CHUNKS[serve]="tests/test_serve.py tests/test_prefix_cache.py tests/test_telemet
 # engine-integration cases (own tiny-model compile), split out so the serve
 # chunk stays under its timeout.
 CHUNKS[sched]="tests/test_sched.py"
+# Paged KV arena: PagePool unit tests plus engine-integration cases that
+# compile their own tiny model — split from serve so that chunk stays
+# under its timeout.
+CHUNKS[paged]="tests/test_paged_kv.py"
 # The chaos matrix spawns real training gangs (subprocess per attempt), so
 # it gets its own chunk rather than riding in deploy.
 CHUNKS[faults]="tests/test_faults.py"
@@ -51,7 +55,7 @@ CHUNKS[lint]="tests/test_analysis.py"
 CHUNKS[graftscope]="tests/test_graftscope.py"
 CHUNKS[slow1]="tests/test_train_e2e.py tests/test_multiprocess.py"
 CHUNKS[slow2]="tests/test_multihost_train.py tests/test_multihost_llama.py tests/test_train_zoo.py"
-ORDER=(lint core parallel1 parallel2 moe train llama deploy serve sched faults graftscope slow1 slow2)
+ORDER=(lint core parallel1 parallel2 moe train llama deploy serve sched paged faults graftscope slow1 slow2)
 
 # --- completeness check: every tests/test_*.py in EXACTLY one chunk ------
 # ...and every declared chunk actually in ORDER: a chunk missing from the

@@ -199,28 +199,38 @@ def test_eviction_respects_byte_budget(med):
     assert c["inserted_blocks"] == 3
     assert c["evictions"] == 1
     assert c["blocks"] == 2
+    # Accounting is exact and lives in one place: the running used_bytes
+    # always equals the sum of the surviving nodes' charges.
+    assert c["used_bytes"] == sum(
+        nd.nbytes for nd in eng.prefix_cache._nodes)
     assert eng.stats.summary()["prefix_cache_evictions"] == 1
 
 
 def test_refcount_pins_blocks_under_insert_pressure():
     """An acquired (in-flight) path is never evicted: insert pressure that
     would need its bytes is skipped instead; after release the same blocks
-    are evictable. Unit-level on PrefixCache with host arrays."""
-    kv = lambda i: [np.zeros((1, 4, 2), np.float32)]     # 32 bytes/block
-    pc = PrefixCache(capacity_bytes=64, block_tokens=4)
+    are evictable (returning their pool pages via release_page).
+    Unit-level on PrefixCache with synthetic page ids."""
+    released: list[int] = []
+    pc = PrefixCache(capacity_bytes=64, block_tokens=4, block_nbytes=32,
+                     release_page=released.append)
+    pages = iter(range(1, 100))
+    page_for = lambda i: next(pages)
     t1 = list(range(8))
-    assert pc.insert(t1, kv) == (2, 0)
+    assert pc.insert(t1, page_for) == (2, 0)
     hit, nodes = pc.acquire(t1 + [99])
     assert hit == 8 and len(nodes) == 2
     # Full + every block protected (leaf pinned, interior has a child):
-    # the insert must skip, not evict under a pending splice.
+    # the insert must skip, not evict under a pending admission.
     t2 = list(range(100, 108))
-    assert pc.insert(t2, kv) == (0, 0)
+    assert pc.insert(t2, page_for) == (0, 0)
     assert pc.skipped_blocks == 1
-    assert all(nd.kv is not None for nd in nodes)
+    assert all(nd.page is not None for nd in nodes)
+    assert released == []            # pinned pages never released
     pc.release(nodes)
-    new, evicted = pc.insert(t2, kv)
+    new, evicted = pc.insert(t2, page_for)
     assert (new, evicted) == (2, 2)
+    assert sorted(released) == [1, 2]    # evicted nodes returned their pages
     with pytest.raises(RuntimeError):
         pc.release(nodes)       # refs already at zero — unbalanced release
 
@@ -228,14 +238,15 @@ def test_refcount_pins_blocks_under_insert_pressure():
 def test_acquire_touches_lru_order():
     """A re-acquired block becomes most-recently-used: eviction picks the
     other, untouched entry."""
-    kv = lambda i: [np.zeros((1, 4, 2), np.float32)]
-    pc = PrefixCache(capacity_bytes=64, block_tokens=4)
+    pages = iter(range(1, 100))
+    page_for = lambda i: next(pages)
+    pc = PrefixCache(capacity_bytes=64, block_tokens=4, block_nbytes=32)
     a, b = [1] * 4, [2] * 4
-    pc.insert(a, kv)
-    pc.insert(b, kv)
+    pc.insert(a, page_for)
+    pc.insert(b, page_for)
     hit, nodes = pc.acquire(a + [0])     # touch a — b becomes LRU
     pc.release(nodes)
-    pc.insert([3] * 4, kv)               # needs room: must evict b, not a
+    pc.insert([3] * 4, page_for)         # needs room: must evict b, not a
     assert pc.acquire(a + [0])[0] == 4
     assert pc.acquire(b + [0])[0] == 0
 
@@ -278,7 +289,12 @@ def test_engine_flag_validation(med):
         ServeEngine(model, params, prefix_cache_mb=1.0,
                     prefix_block_tokens=0)
     with pytest.raises(ValueError):
+        ServeEngine(model, params, kv_pool_pages=0)
+    with pytest.raises(ValueError):
         PrefixCache(capacity_bytes=1 << 20, block_tokens=0)
+    with pytest.raises(ValueError):
+        # block_nbytes is required: fit tests must never touch arrays.
+        PrefixCache(capacity_bytes=1 << 20, block_tokens=4)
 
 
 def test_cli_rejects_bad_serving_flags():
