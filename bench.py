@@ -1189,6 +1189,103 @@ def measure_request_trace_overhead(n_requests: int = 8, num_slots: int = 4,
     }
 
 
+def measure_fleet_overhead(n_requests: int = 8, num_slots: int = 4,
+                           out_len: int = 48, repeats: int = 10,
+                           seed: int = 0) -> dict:
+    """Fleet-scrape overhead on the serving hot path: the engine run with
+    a live exporter being polled by a 1 Hz :class:`telemetry.fleet
+    .FleetScraper` (each poll renders the registry — the serving
+    collector reads ``stats.summary()`` under the registry locks the
+    decode loop also touches — then parses the exposition) vs the same
+    run with no telemetry at all. The true cost is tiny (~1 ms per poll
+    measured in isolation, a handful of polls per multi-second window,
+    so ~0.1% of step time), far below single-core load swings — the
+    estimator is therefore the request-trace bench's drift-proof one:
+    each repeat runs both modes back-to-back (order alternating) and
+    the reported overhead is the MEDIAN of the paired ratios; a
+    min-of-mins across the whole run was observed billing ±5% of pure
+    neighbor drift to whichever mode drew the louder minutes.
+    The telemetry-suite gate asserts < 2%."""
+    import os as _os  # noqa: F401 — parallel imports with siblings
+    import threading
+
+    import numpy as np
+
+    from k8s_distributed_deeplearning_tpu.serve import Request, ServeEngine
+    from k8s_distributed_deeplearning_tpu.telemetry import bridge
+    from k8s_distributed_deeplearning_tpu.telemetry import fleet as fleet_mod
+    from k8s_distributed_deeplearning_tpu.telemetry.exporter import (
+        MetricsExporter)
+    from k8s_distributed_deeplearning_tpu.telemetry.registry import (
+        MetricsRegistry)
+
+    max_seq = 256
+    model, params, cfg, _ = _serve_cpu_model(max_seq)
+    rng = np.random.default_rng(seed)
+    prompts = [rng.integers(0, cfg.vocab_size, size=int(
+        rng.integers(32, 128))).astype(np.int32) for _ in range(n_requests)]
+
+    scrape_count = [0]
+
+    def run(scraped: bool) -> float:
+        eng = ServeEngine(model, params, num_slots=num_slots,
+                          max_queue=n_requests)
+        exporter = poller = None
+        stop = threading.Event()
+        if scraped:
+            registry = MetricsRegistry()
+            bridge.serving_collector(registry, eng.stats)
+            exporter = MetricsExporter(registry, host="127.0.0.1",
+                                       port=0).start()
+            scraper = fleet_mod.FleetScraper(
+                [f"127.0.0.1:{exporter.port}"], timeout_s=2.0)
+
+            def poll_loop() -> None:
+                n = 0
+                while not stop.is_set():
+                    scraper.poll()      # 1 Hz, first poll immediate
+                    n += 1
+                    stop.wait(1.0)
+                scrape_count[0] = n
+
+            poller = threading.Thread(target=poll_loop, daemon=True)
+            poller.start()
+        reqs = [Request(prompt=p, max_new_tokens=out_len) for p in prompts]
+        t0 = time.perf_counter()
+        eng.run(reqs)
+        dt = (time.perf_counter() - t0) / max(eng.stats.steps, 1)
+        if scraped:
+            stop.set()
+            poller.join(timeout=5.0)
+            exporter.stop()
+        return dt
+
+    run(False)                               # warmup replays (compiles)
+    run(True)
+    times = {False: float("inf"), True: float("inf")}
+    pcts = []
+    for i in range(repeats):
+        pair = {}
+        for mode in ((False, True) if i % 2 == 0 else (True, False)):
+            pair[mode] = run(mode)
+            times[mode] = min(times[mode], pair[mode])
+        pcts.append((pair[True] - pair[False]) / pair[False] * 100.0)
+    pcts.sort()
+    mid = len(pcts) // 2
+    overhead = (pcts[mid] if len(pcts) % 2
+                else (pcts[mid - 1] + pcts[mid]) / 2)
+    return {
+        "fleet_overhead_pct": round(overhead, 3),
+        "fleet_paired_pcts": [round(p, 2) for p in pcts],
+        "serve_step_ms_unscraped": round(times[False] * 1e3, 4),
+        "serve_step_ms_scraped": round(times[True] * 1e3, 4),
+        "fleet_scrapes_last_window": scrape_count[0],
+        "fleet_config": {"requests": n_requests, "slots": num_slots,
+                         "out_len": out_len, "repeats": repeats,
+                         "scrape_hz": 1.0},
+    }
+
+
 _RECOVERY_WORKER = '''\
 """Recovery-bench worker: tiny train run that logs wall-clock step events
 to a shared file, so the parent can time kill -> first post-restore step
@@ -1534,18 +1631,26 @@ def main() -> None:
         extra = measure_telemetry_overhead(steps=args.steps,
                                            warmup=args.warmup)
         extra.update(measure_request_trace_overhead())
+        extra.update(measure_fleet_overhead())
         emit({
             "metric": "telemetry_overhead_pct",
             "value": extra["telemetry_overhead_pct"],
             "unit": "% of mean step time (tracing on vs off)",
             "vs_baseline": None,
             "extra": extra})
-        # Absolute gate, independent of the stored baseline: full-rate
-        # request-lifecycle sampling must cost < 2% of serve step time.
+        # Absolute gates, independent of the stored baseline: full-rate
+        # request-lifecycle sampling and a live 1 Hz fleet scrape must
+        # each cost < 2% of serve step time.
+        gates = []
         if extra["request_trace_overhead_pct"] >= 2.0:
-            print("GATE request_trace_overhead_pct: "
-                  f"{extra['request_trace_overhead_pct']} >= 2.0",
-                  file=sys.stderr)
+            gates.append("GATE request_trace_overhead_pct: "
+                         f"{extra['request_trace_overhead_pct']} >= 2.0")
+        if extra["fleet_overhead_pct"] >= 2.0:
+            gates.append("GATE fleet_overhead_pct: "
+                         f"{extra['fleet_overhead_pct']} >= 2.0")
+        for g in gates:
+            print(g, file=sys.stderr)
+        if gates:
             sys.exit(2)
         return
     if args.suite == "recovery":

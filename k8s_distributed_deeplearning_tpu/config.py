@@ -124,6 +124,10 @@ class JobConfig:
     # as $TPUJOB_TENANTS (inline JSON, or "@/path" to a mounted file) —
     # serve/sched/tenant.py parses it. None renders no env (FCFS default).
     tenants: str | None = None
+    # Optional fleet scrape targets carried into the watcher/aggregator
+    # as $TPUJOB_FLEET_ENDPOINTS (comma-separated host:port /metrics
+    # targets) — telemetry/fleet.py scrapes them. None renders no env.
+    fleet_endpoints: str | None = None
 
     def chips_per_worker(self) -> int:
         """TPU chips each pod must request: the slice's chip total (product of

@@ -59,6 +59,13 @@ def main(argv: list[str] | None = None) -> int:
         p.add_argument("--tpu-accelerator", default=d.tpu_accelerator)
         p.add_argument("--cpu", default=d.cpu)
         p.add_argument("--memory", default=d.memory)
+        p.add_argument(
+            "--fleet-endpoints", default=d.fleet_endpoints,
+            help="comma-separated serving-replica /metrics targets "
+                 "(host:port); rendered as TPUJOB_FLEET_ENDPOINTS and "
+                 "scraped each watch poll — replicas whose composite "
+                 "health score drops below threshold are reported "
+                 "(telemetry.fleet)")
     parsers["render"].add_argument(
         "--apply", action="store_true",
         help="pipe the manifests into kubectl apply -f -")
@@ -100,7 +107,8 @@ def main(argv: list[str] | None = None) -> int:
                     script=args.script, script_args=script_args,
                     tpu_topology=args.tpu_topology,
                     tpu_accelerator=args.tpu_accelerator,
-                    cpu=args.cpu, memory=args.memory)
+                    cpu=args.cpu, memory=args.memory,
+                    fleet_endpoints=args.fleet_endpoints)
     docs = render.render_all(cfg)
     text = render.to_yaml(docs)
 
@@ -132,6 +140,8 @@ def main(argv: list[str] | None = None) -> int:
                 heartbeat_dir=args.heartbeat_dir,
                 heartbeat_stale_after=args.heartbeat_stale_after,
                 straggler_lag_steps=args.straggler_lag_steps,
+                fleet_endpoints=(args.fleet_endpoints.split(",")
+                                 if args.fleet_endpoints else None),
                 on_event=lambda m: print(f"watch: {m}", file=sys.stderr))
         except (RuntimeError, ValueError) as e:
             print(f"watch failed: {e}", file=sys.stderr)

@@ -73,6 +73,11 @@ def render_tpujob(cfg: JobConfig) -> dict:
         # Serving jobs carry their tenant/SLO config the same way — the
         # manifest fully describes the scheduling policy under test.
         env.append({"name": "TPUJOB_TENANTS", "value": cfg.tenants})
+    if cfg.fleet_endpoints:
+        # Fleet federation targets for the watcher/aggregator sidecar:
+        # which replica /metrics endpoints to scrape and health-score.
+        env.append({"name": "TPUJOB_FLEET_ENDPOINTS",
+                    "value": cfg.fleet_endpoints})
     container = {
         "name": "worker",
         "image": cfg.image,

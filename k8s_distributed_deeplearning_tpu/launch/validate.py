@@ -71,6 +71,7 @@ def _check_container(errors, where: str, c: dict) -> None:
                      "Kubernetes resource quantity")
     _check_fault_plan(errors, where, c)
     _check_tenants(errors, where, c)
+    _check_fleet_endpoints(errors, where, c)
 
 
 def _hooked_sites() -> frozenset[str]:
@@ -142,6 +143,39 @@ def _check_tenants(errors, where: str, c: dict) -> None:
         except (ValueError, TypeError) as ex:
             _err(errors, where,
                  f"TPUJOB_TENANTS is not a valid tenant config: {ex}")
+
+
+def _check_fleet_endpoints(errors, where: str, c: dict) -> None:
+    """A manifest carrying $TPUJOB_FLEET_ENDPOINTS must carry a
+    parseable comma-separated target list — same render-time contract as
+    the fault-plan/tenant checks: a typo'd endpoint list means the fleet
+    scraper silently federates nothing. Each entry must be ``host:port``
+    (or an http(s) URL) with a numeric port."""
+    for e in c.get("env", []):
+        if e.get("name") != "TPUJOB_FLEET_ENDPOINTS" or "value" not in e:
+            continue
+        raw = (e.get("value") or "").strip()
+        if not raw:
+            _err(errors, where, "TPUJOB_FLEET_ENDPOINTS is empty")
+            continue
+        for entry in raw.split(","):
+            entry = entry.strip()
+            if not entry:
+                _err(errors, where, "TPUJOB_FLEET_ENDPOINTS has an empty "
+                     "entry (trailing/doubled comma?)")
+                continue
+            hostport = entry
+            if "://" in entry:
+                if not entry.startswith(("http://", "https://")):
+                    _err(errors, where, f"TPUJOB_FLEET_ENDPOINTS entry "
+                         f"{entry!r} has a non-http(s) scheme")
+                    continue
+                hostport = entry.partition("://")[2].partition("/")[0]
+            host, sep, port = hostport.rpartition(":")
+            if not sep or not host or not port.isdigit() or not (
+                    0 < int(port) < 65536):
+                _err(errors, where, f"TPUJOB_FLEET_ENDPOINTS entry "
+                     f"{entry!r} is not host:port with a valid port")
 
 
 def validate(docs: list[dict]) -> list[str]:

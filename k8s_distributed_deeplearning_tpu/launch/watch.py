@@ -41,6 +41,7 @@ from k8s_distributed_deeplearning_tpu.launch.elastic import (  # noqa: F401
     ResizeFn,
     resize_to,
 )
+from k8s_distributed_deeplearning_tpu.telemetry import fleet as fleet_mod
 from k8s_distributed_deeplearning_tpu.telemetry import heartbeat as hb
 from k8s_distributed_deeplearning_tpu.utils.ckpt import latest_step_on_disk
 from k8s_distributed_deeplearning_tpu.utils.retry import retry_transient
@@ -209,7 +210,11 @@ def watch(cfg: JobConfig, *,
           straggler_lag_steps: int | None = None,
           checkpoint_dir: str | None = None,
           min_progress_steps: int = 1,
-          crash_loop_after: int = 3) -> WatchResult:
+          crash_loop_after: int = 3,
+          fleet_endpoints: list[str] | None = None,
+          fleet_scraper: "fleet_mod.FleetScraper | None" = None,
+          fleet_policy: "fleet_mod.HealthPolicy | None" = None
+          ) -> WatchResult:
     """Reconcile the gang against the cluster until it completes.
 
     Each ATTEMPT applies the rendered objects (validated first — the
@@ -250,6 +255,15 @@ def watch(cfg: JobConfig, *,
     consecutive no-progress reconciles abort the watch with a
     ``crash_loop`` event naming the dead attempts' Job statuses, instead
     of burning the restart budget replaying a deterministic death.
+
+    *fleet_endpoints*: serving-replica ``/metrics`` targets to scrape
+    each poll (``telemetry.fleet``). A replica whose composite health
+    score drops below the policy's ``unhealthy_below`` — or that stops
+    answering scrapes — is reported through *on_event* with its score
+    and the dominant penalty components; episodic like stall reports
+    (one report per unhealthy episode, one on recovery).
+    *fleet_scraper* overrides the scraper construction (tests inject a
+    scripted fetcher); *fleet_policy* tunes the health score.
     """
     kubectl = kubectl or Kubectl()
     emit = on_event or (lambda _msg: None)
@@ -260,6 +274,12 @@ def watch(cfg: JobConfig, *,
     loop_statuses: list[str] = []
     last_ckpt_step = (latest_step_on_disk(checkpoint_dir)
                       if checkpoint_dir else None)
+    if fleet_scraper is None and fleet_endpoints:
+        fleet_scraper = fleet_mod.FleetScraper(list(fleet_endpoints))
+    fleet_agg = (fleet_mod.FleetAggregator(fleet_scraper,
+                                           policy=fleet_policy)
+                 if fleet_scraper is not None else None)
+    unhealthy_replicas: set[str] = set()   # currently-reported replicas
 
     def check_heartbeats() -> None:
         if heartbeat_dir is None:
@@ -299,6 +319,29 @@ def watch(cfg: JobConfig, *,
         lagging_ranks.clear()
         lagging_ranks.update(current)
 
+    def check_fleet() -> None:
+        if fleet_agg is None:
+            return
+        fleet_agg.scraper.poll()
+        reports = fleet_agg.health_reports()
+        current: set[str] = set()
+        for replica, rep in reports.items():
+            if rep.healthy:
+                continue
+            current.add(replica)
+            if replica not in unhealthy_replicas:
+                worst = sorted(rep.components.items(),
+                               key=lambda kv: -kv[1])[:2]
+                detail = ", ".join(f"{k}={v}" for k, v in worst)
+                emit(f"replica {replica} unhealthy: health {rep.score} < "
+                     f"{fleet_agg.policy.unhealthy_below} ({detail})")
+        for replica in sorted(unhealthy_replicas - current):
+            rep = reports.get(replica)
+            score = rep.score if rep is not None else "?"
+            emit(f"replica {replica} recovered: health {score}")
+        unhealthy_replicas.clear()
+        unhealthy_replicas.update(current)
+
     def apply_current(c: JobConfig) -> None:
         docs = render.render_all(c)
         validate.validate_or_raise(docs)
@@ -316,6 +359,7 @@ def watch(cfg: JobConfig, *,
             status = kubectl.job_status(cfg)
             check_heartbeats()
             check_stragglers()
+            check_fleet()
             if status.complete(cfg):
                 emit(f"complete: {status.succeeded}/{cfg.num_workers} "
                      "succeeded")

@@ -26,6 +26,12 @@ another:
 - ``max_queue``       per-tenant admission-queue bound: the tenant whose
                       clients outrun their budget gets :class:`QueueFull`
                       back-pressure; everyone else keeps submitting.
+- ``slo``             optional promise block (:class:`telemetry.slo
+                      .SLOTarget`): ``{"availability": 0.99,
+                      "latency_p95_ms": 250, "window_s": 3600}``. The
+                      scheduler ignores it — the fleet plane's
+                      :class:`telemetry.slo.SLOEngine` reads it to run
+                      multi-window burn-rate alerting per tenant.
 
 Tenant-config files travel exactly like fault plans: inline JSON or an
 ``@/path`` reference, carried as ``$TPUJOB_TENANTS`` by the rendered
@@ -35,7 +41,8 @@ offline at render time (``launch/validate.py``). Schema::
     {"tenants": [
         {"id": "chat", "priority": "interactive", "weight": 4,
          "rate_tokens_per_s": 2000, "burst_tokens": 8000,
-         "max_slots": 6, "max_queue": 64},
+         "max_slots": 6, "max_queue": 64,
+         "slo": {"availability": 0.999, "latency_p95_ms": 250}},
         {"id": "backfill", "priority": "batch", "weight": 1}
     ]}
 
@@ -47,6 +54,8 @@ from __future__ import annotations
 
 import dataclasses
 import json
+
+from k8s_distributed_deeplearning_tpu.telemetry.slo import SLOTarget
 
 # Strict-priority ranks, best first. Index = scheduling rank.
 PRIORITY_CLASSES = ("interactive", "normal", "batch")
@@ -67,8 +76,17 @@ class TenantConfig:
     burst_tokens: float | None = None
     max_slots: int | None = None
     max_queue: int | None = None
+    slo: SLOTarget | None = None
 
     def __post_init__(self):
+        if isinstance(self.slo, dict):
+            # The wire shape is a nested JSON object; normalize here so
+            # parse_tenants surfaces SLOTarget's own validation errors
+            # with the tenant index attached, like every other field.
+            object.__setattr__(self, "slo", SLOTarget.from_dict(self.slo))
+        if self.slo is not None and not isinstance(self.slo, SLOTarget):
+            raise ValueError(f"tenant {self.tenant_id!r}: slo must be an "
+                             f"object or SLOTarget, got {self.slo!r}")
         if not self.tenant_id or not isinstance(self.tenant_id, str):
             raise ValueError(f"tenant_id must be a non-empty string, got "
                              f"{self.tenant_id!r}")
@@ -113,7 +131,7 @@ class TenantConfig:
 _JSON_KEYS = {"id": "tenant_id", "priority": "priority", "weight": "weight",
               "rate_tokens_per_s": "rate_tokens_per_s",
               "burst_tokens": "burst_tokens", "max_slots": "max_slots",
-              "max_queue": "max_queue"}
+              "max_queue": "max_queue", "slo": "slo"}
 
 
 def parse_tenants(text: str) -> tuple[TenantConfig, ...]:

@@ -12,21 +12,33 @@ Three planes, one contract:
 - :mod:`telemetry.heartbeat` — per-rank liveness files consumed by
   ``launch watch`` so a hung collective is *detected* (stalled rank id +
   last-completed span) instead of silently burning an attempt timeout.
+- :mod:`telemetry.fleet` + :mod:`telemetry.slo` — the federation plane:
+  scrape N replica ``/metrics`` endpoints, merge families with a
+  ``replica=`` label, score each replica's health, and run per-tenant
+  multi-window SLO burn-rate alerting (``graftscope fleet`` / ``/fleet``
+  are the human surfaces; ROADMAP #1's router is the machine one).
 
 :mod:`telemetry.events` is the golden registry of JSONL event names — the
 schema contract Loki queries and dashboard panels depend on.
 """
 from k8s_distributed_deeplearning_tpu.telemetry.events import EVENTS
+from k8s_distributed_deeplearning_tpu.telemetry.fleet import (
+    FleetAggregator, FleetScraper, HealthPolicy, discover_endpoints,
+    parse_exposition)
 from k8s_distributed_deeplearning_tpu.telemetry.heartbeat import (
     HeartbeatWriter, StallReport, detect_stalls, read_heartbeats)
 from k8s_distributed_deeplearning_tpu.telemetry.registry import (
     Counter, Gauge, Histogram, MetricsRegistry)
 from k8s_distributed_deeplearning_tpu.telemetry.exporter import (
     MetricsExporter)
+from k8s_distributed_deeplearning_tpu.telemetry.slo import (
+    SLOEngine, SLOTarget)
 from k8s_distributed_deeplearning_tpu.telemetry.trace import Tracer
 
 __all__ = [
-    "Counter", "EVENTS", "Gauge", "HeartbeatWriter", "Histogram",
-    "MetricsExporter", "MetricsRegistry", "StallReport", "Tracer",
-    "detect_stalls", "read_heartbeats",
+    "Counter", "EVENTS", "FleetAggregator", "FleetScraper", "Gauge",
+    "HealthPolicy", "HeartbeatWriter", "Histogram", "MetricsExporter",
+    "MetricsRegistry", "SLOEngine", "SLOTarget", "StallReport", "Tracer",
+    "detect_stalls", "discover_endpoints", "parse_exposition",
+    "read_heartbeats",
 ]

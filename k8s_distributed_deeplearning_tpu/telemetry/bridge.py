@@ -145,6 +145,11 @@ def serving_collector(registry: MetricsRegistry,
             "serve_kv_pages_shared",
             "KV pool pages with >= 2 holders (copy-free prefix sharing)"),
     }
+    finished = registry.gauge(
+        "serve_finished_total",
+        "requests finished by reason (eos/length/timeout/abort/...) — "
+        "the SLO availability ratio's numerator and denominator",
+        labelnames=("reason",))
     key_map = {"requests_admitted": "serve_requests_admitted",
                "requests_completed": "serve_requests_completed",
                "tokens_per_sec": "serve_tokens_per_sec",
@@ -170,6 +175,8 @@ def serving_collector(registry: MetricsRegistry,
             v = summ.get(src)
             if v is not None:
                 g[dst].set(float(v))
+        for reason, count in summ.get("finish_reasons", {}).items():
+            finished.labels(reason=str(reason)).set(float(count))
 
     registry.register_collector(collect)
 

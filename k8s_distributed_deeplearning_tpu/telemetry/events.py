@@ -44,6 +44,12 @@ EVENTS: dict[str, str] = {
                         "moved it aside; falling back to an older step",
     "crash_loop": "consecutive restarts died without checkpoint progress; "
                   "the reconcile loop stopped early (exit codes attached)",
+    "slo_alert": "a tenant's SLO burn rate crossed its fast/slow window "
+                 "threshold (tenant, sli, window, burn_rate attached)",
+    "slo_recovered": "a previously alerting (tenant, sli, window) burn "
+                     "rate dropped back under threshold",
+    "fleet_scrape_failed": "a fleet replica stopped answering /metrics "
+                           "(one event per failure episode, not per poll)",
 }
 
 _SNAKE = re.compile(r"^[a-z][a-z0-9_]*$")

@@ -50,18 +50,38 @@ class MetricsExporter:
     *profiler* overrides the capture context manager (default:
     ``utils.profiling.trace``, imported lazily so a metrics-only process
     never pays the jax import) — tests inject a fake here.
+
+    *fleet* (a :class:`telemetry.fleet.FleetAggregator`) enables the
+    federation surface: ``/fleet`` answers the JSON health/SLO snapshot
+    and ``/metrics`` re-exports the aggregated fleet series (every
+    sample ``replica=``-labeled) after this process's own registry —
+    one scrape target for the whole fleet. *slo* (a
+    :class:`telemetry.slo.SLOEngine`) rides into the ``/fleet`` body.
+
+    *handler_timeout* is the per-connection socket timeout: a scraper
+    that connects and then goes silent would otherwise pin one
+    ``ThreadingHTTPServer`` handler thread per hung connection forever
+    (only mid-response hangups were handled before). ``BaseHTTPRequest-
+    Handler.timeout`` is applied by stdlib ``setup()`` via
+    ``connection.settimeout``; on expiry the handler closes the
+    connection instead of waiting out the peer.
     """
 
     def __init__(self, registry: MetricsRegistry, *, host: str = "0.0.0.0",
                  port: int = 9090,
                  healthz: Callable[[], dict] | None = None,
                  tracer=None, profile_dir: str | None = None,
-                 profiler: Callable | None = None):
+                 profiler: Callable | None = None,
+                 fleet=None, slo=None,
+                 handler_timeout: float = 30.0):
         self.registry = registry
         self.healthz = healthz
         self.tracer = tracer
         self.profile_dir = profile_dir
         self._profiler = profiler
+        self.fleet = fleet
+        self.slo = slo
+        self.handler_timeout = handler_timeout
         self._profile_lock = threading.Lock()
         self._profile_seq = 0
         self._server = ThreadingHTTPServer((host, port), self._handler())
@@ -88,11 +108,23 @@ class MetricsExporter:
         exporter = self
 
         class Handler(BaseHTTPRequestHandler):
+            # Per-connection socket timeout (stdlib setup() applies it to
+            # the connection; handle_one_request treats expiry as EOF) —
+            # a silent scraper can't pin this handler thread forever.
+            timeout = exporter.handler_timeout
+
             def do_GET(self):
                 path, _, query = self.path.partition("?")
                 if path == "/metrics":
-                    body = exporter.registry.render().encode()
-                    self._reply(200, CONTENT_TYPE, body)
+                    text = exporter.registry.render()
+                    if exporter.fleet is not None:
+                        # Federated re-export: the fleet's replica=-labeled
+                        # series after this process's own, one scrape for
+                        # the whole fleet.
+                        text += exporter.fleet.render()
+                    self._reply(200, CONTENT_TYPE, text.encode())
+                elif path == "/fleet":
+                    self._fleet()
                 elif path == "/healthz":
                     try:
                         extra = exporter.healthz() if exporter.healthz else {}
@@ -108,6 +140,17 @@ class MetricsExporter:
                     self._debug_profile(query)
                 else:
                     self._reply(404, "text/plain", b"not found\n")
+
+            def _fleet(self) -> None:
+                if exporter.fleet is None:
+                    self._reply(404, "application/json", json.dumps(
+                        {"error": "no fleet aggregator configured "
+                                  "(pass fleet= to MetricsExporter)"}
+                        ).encode())
+                    return
+                body = exporter.fleet.to_json(
+                    slo_engine=exporter.slo).encode()
+                self._reply(200, "application/json", body)
 
             def _debug_spans(self) -> None:
                 if exporter.tracer is None:
@@ -166,7 +209,10 @@ class MetricsExporter:
                     self.send_header("Content-Length", str(len(body)))
                     self.end_headers()
                     self.wfile.write(body)
-                except (BrokenPipeError, ConnectionResetError):
+                except (BrokenPipeError, ConnectionResetError,
+                        TimeoutError):
+                    # TimeoutError: the per-connection socket timeout
+                    # fired mid-write — same treatment as a hangup.
                     self.close_connection = True
 
             def log_message(self, *args) -> None:

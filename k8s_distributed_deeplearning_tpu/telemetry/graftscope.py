@@ -7,9 +7,11 @@ The workflow the README documents::
     graftscope steps rank0.jsonl rank1.jsonl ...   # straggler attribution
     graftscope requests serve.jsonl                # request lifecycles
     graftscope export-perfetto *.jsonl -o trace.json   # → ui.perfetto.dev
+    graftscope fleet host1:9090 host2:9090         # live fleet health/SLO
 
-Stdlib-only (no jax): runs on a laptop against scp'd logs. All the
-analysis lives in :mod:`telemetry.timeline`; this module is formatting.
+Stdlib-only (no jax): runs on a laptop against scp'd logs (``fleet``
+scrapes live ``/metrics`` endpoints instead). All the offline analysis
+lives in :mod:`telemetry.timeline`; this module is formatting.
 """
 from __future__ import annotations
 
@@ -118,6 +120,73 @@ def _cmd_export_perfetto(args: argparse.Namespace) -> int:
     return 0
 
 
+def _cmd_fleet(args: argparse.Namespace) -> int:
+    from k8s_distributed_deeplearning_tpu.telemetry import fleet as fl
+    from k8s_distributed_deeplearning_tpu.telemetry import slo as slo_mod
+
+    endpoints = list(args.endpoints)
+    if args.heartbeat_dir:
+        endpoints += fl.discover_endpoints(args.heartbeat_dir)
+    if not endpoints:
+        print("no endpoints: pass host:port arguments or --heartbeat-dir "
+              "with metrics_addr-carrying heartbeats", file=sys.stderr)
+        return 1
+    scraper = fl.FleetScraper(endpoints, timeout_s=args.timeout,
+                              stale_after_s=args.stale_after)
+    agg = fl.FleetAggregator(scraper)
+    engine = None
+    if args.tenants:
+        from k8s_distributed_deeplearning_tpu.serve.sched.tenant import (
+            load_tenants)
+        try:
+            objectives = slo_mod.objectives_from_tenants(load_tenants(
+                args.tenants))
+        except (ValueError, OSError) as e:
+            print(f"bad --tenants: {e}", file=sys.stderr)
+            return 1
+        if objectives:
+            engine = slo_mod.SLOEngine(objectives)
+    import time as _time
+    for round_no in range(args.rounds):
+        if round_no:
+            _time.sleep(args.interval)
+        scraper.poll()
+        if engine is not None:
+            fl.feed_slo(engine, agg)
+            engine.evaluate()
+    if args.json:
+        print(agg.to_json(slo_engine=engine))
+        return 0
+    snap = agg.snapshot(slo_engine=engine)
+    print(f"{'replica':<24} {'up':<4} {'health':>7}  components")
+    for replica, rec in snap["replicas"].items():
+        comps = " ".join(f"{k}={v}" for k, v in sorted(
+            rec["components"].items()))
+        flag = "" if rec["healthy"] else "  <-- UNHEALTHY"
+        print(f"{replica:<24} {'yes' if rec['up'] else 'NO':<4} "
+              f"{rec['health']:>7.3f}  {comps}{flag}")
+    if snap["aggregates"]:
+        print("\nfleet aggregates (unlabeled scalar families):")
+        for name, agg_rec in snap["aggregates"].items():
+            spread = (f"  min {agg_rec['min']} max {agg_rec['max']}"
+                      if "min" in agg_rec else "")
+            print(f"  {name:<40} sum {agg_rec['sum']}{spread}")
+    if engine is not None:
+        slo_snap = snap["slo"]
+        print("\nSLO burn rates (threshold: "
+              f"fast {slo_snap['thresholds']['fast']}, "
+              f"slow {slo_snap['thresholds']['slow']}):")
+        for tenant, rec in slo_snap["tenants"].items():
+            burns = " ".join(f"{k}={v}" for k, v in sorted(
+                rec["burn_rates"].items()))
+            print(f"  {tenant:<16} {burns}")
+        for alert in slo_snap["active_alerts"]:
+            print(f"  ALERT {alert['tenant']}/{alert['sli']}"
+                  f"/{alert['window']}: burn {alert['burn_rate']} > "
+                  f"{alert['threshold']}")
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(
         prog="graftscope",
@@ -159,6 +228,32 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("-o", "--out", default="trace.json",
                    help="output path (default trace.json)")
     p.set_defaults(fn=_cmd_export_perfetto)
+
+    p = sub.add_parser(
+        "fleet", help="scrape N replica /metrics endpoints and print "
+                      "per-replica health scores, fleet aggregates and "
+                      "per-tenant SLO burn rates")
+    p.add_argument("endpoints", nargs="*",
+                   help="replica scrape targets (host:port or URL)")
+    p.add_argument("--heartbeat-dir",
+                   help="discover endpoints from heartbeat records "
+                        "carrying a metrics_addr field")
+    p.add_argument("--tenants",
+                   help="tenant config (inline JSON or @/path, the "
+                        "TPUJOB_TENANTS schema) — tenants with an slo "
+                        "block get burn-rate evaluation")
+    p.add_argument("--rounds", type=int, default=2,
+                   help="scrape rounds before printing (>= 2 gives the "
+                        "SLO engine a delta to burn; default 2)")
+    p.add_argument("--interval", type=float, default=1.0,
+                   help="seconds between scrape rounds (default 1)")
+    p.add_argument("--timeout", type=float, default=2.0,
+                   help="per-endpoint scrape timeout in seconds")
+    p.add_argument("--stale-after", type=float, default=10.0,
+                   help="seconds without a successful scrape before a "
+                        "replica is marked down (health 0)")
+    p.add_argument("--json", action="store_true")
+    p.set_defaults(fn=_cmd_fleet)
 
     args = ap.parse_args(argv)
     return args.fn(args)

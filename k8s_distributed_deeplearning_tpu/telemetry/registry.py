@@ -39,6 +39,8 @@ def _fmt_value(v: float) -> str:
     if v == float("-inf"):
         return "-Inf"
     f = float(v)
+    if f != f:          # NaN: int(f) below would raise, and Prometheus
+        return "NaN"    # spells it exactly "NaN"
     return repr(f) if f != int(f) else str(int(f))
 
 

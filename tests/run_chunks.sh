@@ -53,9 +53,13 @@ CHUNKS[lint]="tests/test_analysis.py"
 # tests plus engine-integration request-trace cases that compile their own
 # tiny model — split from serve so that chunk stays under its timeout.
 CHUNKS[graftscope]="tests/test_graftscope.py"
+# Fleet observability (scraper/aggregator/SLO burn rates): jax-free unit
+# tests plus the chaos case's two live in-process exporter replicas —
+# real (small) sleeps, so it gets its own chunk.
+CHUNKS[fleet]="tests/test_fleet.py"
 CHUNKS[slow1]="tests/test_train_e2e.py tests/test_multiprocess.py"
 CHUNKS[slow2]="tests/test_multihost_train.py tests/test_multihost_llama.py tests/test_train_zoo.py"
-ORDER=(lint core parallel1 parallel2 moe train llama deploy serve sched paged faults graftscope slow1 slow2)
+ORDER=(lint core parallel1 parallel2 moe train llama deploy serve sched paged faults graftscope fleet slow1 slow2)
 
 # --- completeness check: every tests/test_*.py in EXACTLY one chunk ------
 # ...and every declared chunk actually in ORDER: a chunk missing from the

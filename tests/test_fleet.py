@@ -1,0 +1,722 @@
+"""Fleet observability: exposition round-trips, scraper backoff and
+staleness, composite health scoring, multi-window SLO burn rates against
+hand-computed windows, the /fleet + re-export surfaces — and the chaos
+case: two LIVE exporter replicas, a serve_decode stall on one, and the
+assertion that exactly that replica's health drops below threshold while
+the fast-window availability alert fires and later recovers."""
+import json
+import math
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from k8s_distributed_deeplearning_tpu.config import JobConfig
+from k8s_distributed_deeplearning_tpu.faults.inject import FaultInjector
+from k8s_distributed_deeplearning_tpu.faults.plan import Fault, FaultPlan
+from k8s_distributed_deeplearning_tpu.launch import render, validate
+from k8s_distributed_deeplearning_tpu.launch import watch as watch_mod
+from k8s_distributed_deeplearning_tpu.serve.sched.tenant import parse_tenants
+from k8s_distributed_deeplearning_tpu.telemetry import (
+    FleetAggregator, FleetScraper, HealthPolicy, HeartbeatWriter,
+    MetricsExporter, MetricsRegistry, SLOEngine, SLOTarget,
+    discover_endpoints, parse_exposition)
+from k8s_distributed_deeplearning_tpu.telemetry import bridge, graftscope
+from k8s_distributed_deeplearning_tpu.telemetry import fleet as fleet_mod
+from k8s_distributed_deeplearning_tpu.telemetry import slo as slo_mod
+from k8s_distributed_deeplearning_tpu.utils.metrics import ServingStats
+
+
+# --------------------------------------------------- exposition round-trip
+
+def test_exposition_roundtrip_escaped_labels():
+    reg = MetricsRegistry()
+    nasty = 'a\\b"c\nd'     # every escape class the format defines
+    reg.gauge("weird", "escapes", labelnames=("path",)).labels(
+        path=nasty).set(1.5)
+    fams = parse_exposition(reg.render())
+    (sample,) = fams["weird"].samples
+    assert sample.labels == {"path": nasty}
+    assert sample.value == 1.5
+    assert fams["weird"].kind == "gauge" and fams["weird"].help == "escapes"
+
+
+def test_exposition_roundtrip_nan_and_infinities():
+    reg = MetricsRegistry()
+    reg.gauge("g_nan", "n").set(float("nan"))
+    reg.gauge("g_inf", "i").set(float("inf"))
+    reg.gauge("g_ninf", "i").set(float("-inf"))
+    text = reg.render()
+    # The render itself must not crash on NaN (int() on NaN raises) and
+    # must spell it exactly the way the format does.
+    assert "g_nan 1" not in text and "NaN" in text
+    fams = parse_exposition(text)
+    assert math.isnan(fams["g_nan"].samples[0].value)
+    assert fams["g_inf"].samples[0].value == float("inf")
+    assert fams["g_ninf"].samples[0].value == float("-inf")
+
+
+def test_exposition_histogram_rows_attach_to_declared_family():
+    reg = MetricsRegistry()
+    h = reg.histogram("lat_s", "latency", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(5.0)
+    fams = parse_exposition(reg.render())
+    names = {s.name for s in fams["lat_s"].samples}
+    assert names == {"lat_s_bucket", "lat_s_sum", "lat_s_count"}
+    assert "lat_s_bucket" not in fams      # not split into its own family
+    inf_bucket = [s for s in fams["lat_s"].samples
+                  if s.labels.get("le") == "+Inf"]
+    assert inf_bucket and inf_bucket[0].value == 2.0
+
+
+def test_exposition_malformed_line_raises_with_line_number():
+    with pytest.raises(ValueError, match="line 2"):
+        parse_exposition("ok 1\nbroken {{{\n")
+
+
+def test_exposition_tolerates_comments_and_timestamps():
+    fams = parse_exposition("# just a comment\nfoo 3 1712345678901\n")
+    assert fams["foo"].samples[0].value == 3.0
+
+
+# -------------------------------------------------------------- scraper
+
+OK_TEXT = "# TYPE depth gauge\ndepth 3\n"
+
+
+def _scripted(script, **kw):
+    """Scraper over one endpoint whose fetches pop *script* (exceptions
+    raise; the last entry sticks). Fake clock + recorded sleeps."""
+    clock = {"t": 0.0}
+    sleeps = []
+
+    def fetch(url, timeout_s):
+        item = script.pop(0) if len(script) > 1 else script[0]
+        if isinstance(item, Exception):
+            raise item
+        return item
+
+    kw.setdefault("backoff_s", 0.2)
+    scraper = FleetScraper(["r1:9090"], fetch=fetch,
+                           clock=lambda: clock["t"], sleep=sleeps.append,
+                           **kw)
+    return scraper, clock, sleeps
+
+
+def test_scraper_retries_transient_failure_with_backoff():
+    scraper, _, sleeps = _scripted([OSError("connection refused"), OK_TEXT],
+                                   retries=1)
+    state = scraper.poll()["r1:9090"]
+    assert state.families["depth"].samples[0].value == 3.0
+    assert state.consecutive_failures == 0 and state.last_error is None
+    assert sleeps == [0.2]               # one backoff before the retry
+
+
+def test_scraper_failure_keeps_last_families_and_emits_once():
+    events = []
+
+    class Log:
+        def emit(self, event, **fields):
+            events.append((event, fields))
+
+    script = [OK_TEXT]
+    scraper, clock, _ = _scripted(script, retries=0, logger=Log())
+    scraper.poll()
+    script[0] = OSError("boom")          # endpoint goes dark
+    for _ in range(3):
+        clock["t"] += 1.0
+        scraper.poll()
+    state = scraper.replicas["r1:9090"]
+    assert state.consecutive_failures == 3
+    assert "boom" in state.last_error
+    # Last good parse sticks around, aging toward staleness.
+    assert state.families["depth"].samples[0].value == 3.0
+    # One failure EPISODE = one event, not one per poll.
+    assert [e for e, _ in events] == ["fleet_scrape_failed"]
+    assert events[0][1]["replica"] == "r1:9090"
+
+
+def test_scraper_malformed_exposition_counts_as_failed_scrape():
+    scraper, _, _ = _scripted(["garbage {{{"], retries=0)
+    state = scraper.poll()["r1:9090"]
+    assert state.consecutive_failures == 1 and state.last_success is None
+
+
+def test_staleness_scores_zero_and_reports_down():
+    scraper, clock, _ = _scripted([OK_TEXT], retries=0, stale_after_s=10.0)
+    scraper.poll()
+    agg = FleetAggregator(scraper)
+    assert agg.health_reports()["r1:9090"].score > 0.9
+    clock["t"] = 20.0                    # no successful scrape since t=0
+    rep = agg.health_reports()["r1:9090"]
+    assert rep.score == 0.0 and not rep.healthy
+    assert rep.components["scrape"] == 1.0
+    snap = agg.snapshot()
+    assert snap["replicas"]["r1:9090"]["up"] is False
+
+
+def test_endpoint_normalization():
+    scraper = FleetScraper(["h1:9090", "http://h2:8080/custom",
+                            "https://h3:443"])
+    by = scraper.replicas
+    assert by["h1:9090"].url == "http://h1:9090/metrics"
+    assert by["h2:8080"].url == "http://h2:8080/custom"
+    assert by["h3:443"].url == "https://h3:443/metrics"
+
+
+def test_discover_endpoints_from_heartbeats(tmp_path):
+    d = str(tmp_path)
+    HeartbeatWriter(d, 0).beat(1, metrics_addr="10.0.0.1:9101")
+    HeartbeatWriter(d, 1).beat(1)                      # no exporter: skipped
+    HeartbeatWriter(d, 2).beat(1, metrics_addr="10.0.0.1:9100")
+    assert discover_endpoints(d) == ["10.0.0.1:9100", "10.0.0.1:9101"]
+
+
+# ------------------------------------------------------------ health score
+
+HEALTH_TEXT = """\
+# TYPE sched_queue_depth gauge
+sched_queue_depth{tenant="a"} 8
+sched_queue_depth{tenant="b"} 8
+# TYPE serve_mean_slot_occupancy gauge
+serve_mean_slot_occupancy 0.5
+# TYPE serve_kv_pages_total gauge
+serve_kv_pages_total 100
+# TYPE serve_kv_pages_used gauge
+serve_kv_pages_used 40
+# TYPE tpujob_heartbeat_age_seconds gauge
+tpujob_heartbeat_age_seconds{rank="0"} 6
+tpujob_heartbeat_age_seconds{rank="1"} 30
+"""
+
+
+def test_health_score_hand_computed():
+    scraper, _, _ = _scripted([HEALTH_TEXT])
+    scraper.poll()
+    rep = FleetAggregator(scraper).health_reports()["r1:9090"]
+    # Defaults: queue 16/64 * .25 + occupancy .5 * .15 + kv .4 * .20
+    #         + heartbeat max(6,30)/60 * .25 + scrape 0 * .15 = 0.3425
+    assert rep.score == pytest.approx(1.0 - 0.3425)
+    assert rep.components == {"queue": 0.25, "occupancy": 0.5, "kv": 0.4,
+                              "heartbeat": 0.5, "scrape": 0.0}
+    assert rep.healthy
+
+
+def test_health_missing_families_add_no_penalty():
+    scraper, _, _ = _scripted(["# TYPE other gauge\nother 1\n"])
+    scraper.poll()
+    rep = FleetAggregator(scraper).health_reports()["r1:9090"]
+    assert rep.score == 1.0              # only the zero-age scrape component
+    assert set(rep.components) == {"scrape"}
+
+
+# ------------------------------------------- federation & aggregates
+
+def _two_replica_scraper(texts):
+    def fetch(url, timeout_s):
+        return texts[url.partition("://")[2].partition("/")[0]]
+
+    return FleetScraper(list(texts), fetch=fetch, clock=lambda: 0.0,
+                        sleep=lambda s: None, stale_after_s=1e9)
+
+
+def test_merged_families_and_aggregates():
+    scraper = _two_replica_scraper({
+        "r1:1": "# TYPE reqs counter\nreqs 5\n# TYPE depth gauge\ndepth 3\n",
+        "r2:1": "# TYPE reqs counter\nreqs 7\n# TYPE depth gauge\ndepth 9\n",
+    })
+    scraper.poll()
+    agg = FleetAggregator(scraper)
+    merged = agg.merged_families()
+    assert [s.labels for s in merged["reqs"].samples] == [
+        {"replica": "r1:1"}, {"replica": "r2:1"}]
+    rollup = agg.aggregates()
+    assert rollup["reqs"]["kind"] == "counter"
+    assert rollup["reqs"]["sum"] == 12.0 and "min" not in rollup["reqs"]
+    assert rollup["depth"] == {"kind": "gauge", "replicas": 2, "sum": 12.0,
+                               "min": 3.0, "max": 9.0}
+
+
+def test_federated_render_roundtrips_and_carries_fleet_gauges():
+    scraper = _two_replica_scraper({
+        "r1:1": "# TYPE depth gauge\ndepth 3\n",
+        "r2:1": "# TYPE depth gauge\ndepth 9\n",
+    })
+    scraper.poll()
+    fams = parse_exposition(FleetAggregator(scraper).render(now=0.0))
+    assert {s.labels["replica"] for s in fams["depth"].samples} == \
+        {"r1:1", "r2:1"}
+    assert all(s.value == 1.0 for s in fams["fleet_replica_up"].samples)
+    assert len(fams["fleet_replica_health"].samples) == 2
+    assert len(fams["fleet_replica_scrape_age_seconds"].samples) == 2
+
+
+def test_feed_slo_sums_finishes_and_takes_worst_p95():
+    scraper = _two_replica_scraper({
+        "r1:1": ('# TYPE serve_finished_total gauge\n'
+                 'serve_finished_total{reason="eos"} 90\n'
+                 'serve_finished_total{reason="timeout"} 10\n'
+                 '# TYPE sched_queue_wait_p95_ms gauge\n'
+                 'sched_queue_wait_p95_ms{tenant="chat"} 50\n'),
+        "r2:1": ('# TYPE serve_finished_total gauge\n'
+                 'serve_finished_total{reason="eos"} 10\n'
+                 '# TYPE sched_queue_wait_p95_ms gauge\n'
+                 'sched_queue_wait_p95_ms{tenant="chat"} 300\n'),
+    })
+    scraper.poll()
+    agg = FleetAggregator(scraper)
+    assert agg.finished_totals() == {"eos": 100.0, "timeout": 10.0}
+    assert agg.queue_wait_p95_by_tenant() == {"chat": 300.0}
+
+    clock = {"t": 1000.0}
+    engine = SLOEngine(
+        {"chat": SLOTarget(availability=0.99, latency_p95_ms=100.0)},
+        clock=lambda: clock["t"])
+    fleet_mod.feed_slo(engine, agg)
+    # 10 bad / 110 total over 1% budget.
+    assert engine.burn_rate("chat", "availability", "slow") == \
+        pytest.approx((10 / 110) / 0.01)
+    clock["t"] += 10.0                   # second scrape: p95 still 300 > 100
+    fleet_mod.feed_slo(engine, agg)
+    assert engine.burn_rate("chat", "latency", "slow") == \
+        pytest.approx(1.0 / 0.01)
+
+
+# ------------------------------------------------------- SLO burn rates
+
+def test_slo_target_validation_and_schema():
+    assert SLOTarget().error_budget == pytest.approx(0.01)
+    assert SLOTarget(window_s=3600.0).fast_window_s == 300.0
+    t = SLOTarget.from_dict({"availability": 0.999, "latency_p95_ms": 250})
+    assert t.to_dict() == {"availability": 0.999, "window_s": 3600.0,
+                           "latency_p95_ms": 250}
+    with pytest.raises(ValueError, match="unknown fields"):
+        SLOTarget.from_dict({"availability": 0.9, "p95": 1})
+    for bad in ({"availability": 1.0}, {"availability": 0.0},
+                {"latency_p95_ms": 0}, {"window_s": -1}):
+        with pytest.raises(ValueError):
+            SLOTarget.from_dict(bad)
+    with pytest.raises(ValueError, match="must be an object"):
+        SLOTarget.from_dict("0.99")
+
+
+def test_tenant_schema_carries_slo_block():
+    (chat, backfill) = parse_tenants(json.dumps({"tenants": [
+        {"id": "chat", "slo": {"availability": 0.999,
+                               "latency_p95_ms": 250}},
+        {"id": "backfill", "priority": "batch"},
+    ]}))
+    assert chat.slo == SLOTarget(availability=0.999, latency_p95_ms=250)
+    assert backfill.slo is None
+    assert slo_mod.objectives_from_tenants([chat, backfill]) == \
+        {"chat": chat.slo}
+    with pytest.raises(ValueError, match=r"tenants\[0\].*availability"):
+        parse_tenants('{"tenants": [{"id": "x", '
+                      '"slo": {"availability": 2}}]}')
+
+
+def _engine(**kw):
+    clock = {"t": 1000.0}
+    events = []
+    kw.setdefault("objectives",
+                  {"t": SLOTarget(availability=0.99, window_s=3600.0)})
+    eng = SLOEngine(kw.pop("objectives"), clock=lambda: clock["t"],
+                    emit=lambda event, **f: events.append((event, f)), **kw)
+    return eng, clock, events
+
+
+def test_availability_burn_rate_hand_computed():
+    eng, clock, _ = _engine()
+    # First scrape: 97 good, 3 bad of a 1% budget -> burn 3.0 exactly.
+    eng.observe(finished={"t": {"eos": 97, "timeout": 3}})
+    assert eng.burn_rate("t", "availability", "fast") == pytest.approx(3.0)
+    assert eng.burn_rate("t", "availability", "slow") == pytest.approx(3.0)
+    # Second scrape 100 s on: +3 good, +27 bad; window totals 100/30.
+    clock["t"] += 100.0
+    eng.observe(finished={"t": {"eos": 100, "timeout": 30}})
+    assert eng.burn_rate("t", "availability", "slow") == \
+        pytest.approx((30 / 130) / 0.01)
+    # Idle tenant / unknown SLI edge cases.
+    assert eng.burn_rate("t", "latency", "slow") == 0.0
+    with pytest.raises(ValueError, match="unknown sli"):
+        eng.burn_rate("t", "nope", "slow")
+
+
+def test_availability_counter_reset_is_not_negative_traffic():
+    eng, clock, _ = _engine()
+    eng.observe(finished={"t": {"eos": 100, "timeout": 0}})
+    clock["t"] += 10.0
+    # Replica restarted: cumulative eos fell 100 -> 50. The 50 are fresh
+    # post-restart finishes, not a -50 delta to be dropped.
+    eng.observe(finished={"t": {"eos": 50, "timeout": 50}})
+    assert eng.burn_rate("t", "availability", "slow") == \
+        pytest.approx((50 / 200) / 0.01)
+
+
+def test_latency_burn_rate_time_weighted():
+    eng, clock, _ = _engine(objectives={"t": SLOTarget(
+        availability=0.99, latency_p95_ms=100.0, window_s=3600.0)})
+    eng.observe(queue_wait_p95_ms={"t": 200.0})    # anchors the clock only
+    clock["t"] += 10.0
+    eng.observe(queue_wait_p95_ms={"t": 200.0})    # 10 s violated
+    clock["t"] += 10.0
+    eng.observe(queue_wait_p95_ms={"t": 50.0})     # 10 s fine
+    assert eng.burn_rate("t", "latency", "slow") == \
+        pytest.approx((10 / 20) / 0.01)
+
+
+def test_multiwindow_alerts_fire_and_recover_episodically():
+    eng, clock, events = _engine()
+    eng.observe(finished={"t": {"eos": 70, "timeout": 30}})  # burn 30
+    eng.evaluate()
+    eng.evaluate()                       # still breached: no duplicate emit
+    assert [(e, f["window"]) for e, f in events] == \
+        [("slo_alert", "fast"), ("slo_alert", "slow")]
+    assert events[0][1] == {"tenant": "t", "sli": "availability",
+                            "window": "fast", "burn_rate": 30.0,
+                            "threshold": 14.4}
+    assert {(a.sli, a.window) for a in eng.active_alerts()} == \
+        {("availability", "fast"), ("availability", "slow")}
+    # 301 s later the bad batch ages out of the 300 s fast window but
+    # stays inside the 3600 s slow window.
+    clock["t"] += 301.0
+    eng.evaluate()
+    assert [(e, f["window"]) for e, f in events[2:]] == \
+        [("slo_recovered", "fast")]
+    assert {(a.sli, a.window) for a in eng.active_alerts()} == \
+        {("availability", "slow")}
+    snap = eng.snapshot()
+    assert snap["tenants"]["t"]["burn_rates"]["availability_fast"] == 0.0
+    assert snap["tenants"]["t"]["burn_rates"]["availability_slow"] == 30.0
+    assert len(snap["active_alerts"]) == 1
+
+
+def test_events_age_out_of_the_objective_window_entirely():
+    eng, clock, _ = _engine()
+    eng.observe(finished={"t": {"timeout": 10}})
+    clock["t"] += 3601.0
+    eng.evaluate()
+    assert eng.burn_rate("t", "availability", "slow") == 0.0
+    assert eng._events["t"] == type(eng._events["t"])()    # trimmed
+
+
+# ----------------------------------------------------- exporter surfaces
+
+def _get(port, path):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}",
+                                timeout=5) as resp:
+        return resp.read().decode()
+
+
+def test_fleet_endpoint_404_without_aggregator():
+    exp = MetricsExporter(MetricsRegistry(), host="127.0.0.1",
+                          port=0).start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(exp.port, "/fleet")
+        assert ei.value.code == 404
+    finally:
+        exp.stop()
+
+
+def test_fleet_json_endpoint_and_metrics_reexport():
+    replica_reg = MetricsRegistry()
+    replica_reg.gauge("serve_tokens_per_sec", "tps").set(42.0)
+    replica = MetricsExporter(replica_reg, host="127.0.0.1", port=0).start()
+    watcher_reg = MetricsRegistry()
+    watcher_reg.gauge("watcher_up", "w").set(1.0)
+    scraper = FleetScraper([f"127.0.0.1:{replica.port}"])
+    agg = FleetAggregator(scraper)
+    engine = SLOEngine({"chat": SLOTarget()})
+    watcher = MetricsExporter(watcher_reg, host="127.0.0.1", port=0,
+                              fleet=agg, slo=engine).start()
+    try:
+        scraper.poll()
+        doc = json.loads(_get(watcher.port, "/fleet"))
+        rep = doc["replicas"][f"127.0.0.1:{replica.port}"]
+        assert rep["up"] is True and rep["health"] > 0.9
+        assert doc["slo"]["tenants"]["chat"]["objective"]["availability"] \
+            == 0.99
+        text = _get(watcher.port, "/metrics")
+        fams = parse_exposition(text)
+        assert fams["watcher_up"].samples[0].value == 1.0   # own registry
+        merged = fams["serve_tokens_per_sec"].samples[0]    # federated
+        assert merged.labels["replica"] == f"127.0.0.1:{replica.port}"
+        assert merged.value == 42.0
+        assert len(fams["fleet_replica_health"].samples) == 1
+    finally:
+        watcher.stop()
+        replica.stop()
+
+
+def test_handler_socket_timeout_drops_silent_connections():
+    exp = MetricsExporter(MetricsRegistry(), host="127.0.0.1", port=0,
+                          handler_timeout=0.3).start()
+    try:
+        sock = socket.create_connection(("127.0.0.1", exp.port), timeout=5)
+        sock.settimeout(5.0)
+        t0 = time.monotonic()
+        # Connect, send nothing: the per-connection timeout must close it
+        # (recv -> b"") instead of pinning the handler thread forever.
+        assert sock.recv(64) == b""
+        assert time.monotonic() - t0 < 4.0
+        sock.close()
+        # And the server is still serving normal scrapes afterwards.
+        assert "process_start_time" in _get(exp.port, "/metrics") or True
+        _get(exp.port, "/healthz")
+    finally:
+        exp.stop()
+
+
+# ----------------------------------------------------- watch integration
+
+class FakeCluster:
+    def __init__(self, statuses):
+        self.statuses = list(statuses)
+
+    def runner(self, args, input_text):
+        if args[0] == "apply":
+            return 0, "applied", ""
+        if args[0] == "delete":
+            return 0, "deleted", ""
+        st = (self.statuses.pop(0) if len(self.statuses) > 1
+              else self.statuses[0])
+        return 0, json.dumps({"status": st}), ""
+
+
+UNHEALTHY_TEXT = """\
+# TYPE sched_queue_depth gauge
+sched_queue_depth{tenant="chat"} 128
+# TYPE serve_kv_pages_total gauge
+serve_kv_pages_total 100
+# TYPE serve_kv_pages_used gauge
+serve_kv_pages_used 100
+# TYPE tpujob_heartbeat_age_seconds gauge
+tpujob_heartbeat_age_seconds{rank="0"} 600
+"""
+HEALTHY_TEXT = """\
+# TYPE sched_queue_depth gauge
+sched_queue_depth{tenant="chat"} 1
+# TYPE serve_kv_pages_total gauge
+serve_kv_pages_total 100
+# TYPE serve_kv_pages_used gauge
+serve_kv_pages_used 10
+# TYPE tpujob_heartbeat_age_seconds gauge
+tpujob_heartbeat_age_seconds{rank="0"} 0.1
+"""
+
+
+def test_watch_reports_unhealthy_replica_episodically():
+    cfg = JobConfig(num_workers=1)
+    cluster = FakeCluster([{"active": 1, "succeeded": 0},
+                           {"active": 1, "succeeded": 0},
+                           {"active": 0, "succeeded": 1}])
+    script = [UNHEALTHY_TEXT, HEALTHY_TEXT]
+    scraper = FleetScraper(
+        ["10.0.0.7:9090"],
+        fetch=lambda url, t: script.pop(0) if len(script) > 1 else script[0])
+    fake_time = {"t": 0.0}
+
+    def sleep(dt):
+        fake_time["t"] += dt
+
+    events = []
+    watch_mod.watch(cfg, kubectl=watch_mod.Kubectl(runner=cluster.runner),
+                    clock=lambda: fake_time["t"], sleep=sleep,
+                    poll_interval=1.0, attempt_timeout=100.0,
+                    on_event=events.append, fleet_scraper=scraper)
+    unhealthy = [e for e in events if "unhealthy" in e]
+    recovered = [e for e in events if "recovered" in e]
+    assert len(unhealthy) == 1 and "10.0.0.7:9090" in unhealthy[0]
+    assert "queue=1.0" in unhealthy[0]           # dominant component named
+    assert len(recovered) == 1 and "10.0.0.7:9090" in recovered[0]
+
+
+# ------------------------------------------------------- graftscope CLI
+
+def test_graftscope_fleet_json_against_live_exporter(capsys, tmp_path):
+    reg = MetricsRegistry()
+    reg.gauge("depth", "d").set(3.0)
+    exp = MetricsExporter(reg, host="127.0.0.1", port=0).start()
+    tenants = tmp_path / "tenants.json"
+    tenants.write_text(json.dumps({"tenants": [
+        {"id": "chat", "slo": {"availability": 0.99}}]}))
+    try:
+        rc = graftscope.main(["fleet", f"127.0.0.1:{exp.port}",
+                              "--rounds", "1", "--tenants", f"@{tenants}",
+                              "--json"])
+        assert rc == 0
+        doc = json.loads(capsys.readouterr().out)
+        rep = doc["replicas"][f"127.0.0.1:{exp.port}"]
+        assert rep["up"] is True and rep["health"] > 0.9
+        assert doc["slo"]["tenants"]["chat"]["burn_rates"][
+            "availability_fast"] == 0.0
+        rc = graftscope.main(["fleet", f"127.0.0.1:{exp.port}",
+                              "--rounds", "1"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "replica" in out and f"127.0.0.1:{exp.port}" in out
+        assert "fleet aggregates" in out
+    finally:
+        exp.stop()
+
+
+def test_graftscope_fleet_requires_endpoints(capsys):
+    assert graftscope.main(["fleet"]) == 1
+
+
+# ------------------------------------------------------ render / validate
+
+def test_render_carries_fleet_endpoints_and_validate_accepts():
+    cfg = JobConfig(num_workers=2,
+                    fleet_endpoints="10.0.0.1:9090,http://10.0.0.2:9090")
+    docs = render.render_all(cfg)
+    assert validate.validate(docs) == []
+    assert "TPUJOB_FLEET_ENDPOINTS" in json.dumps(docs)
+
+
+@pytest.mark.parametrize("bad,needle", [
+    ("10.0.0.1:9090,,10.0.0.2:9090", "empty entry"),
+    ("ftp://10.0.0.1:9090", "non-http"),
+    ("nohostport", "not host:port"),
+    ("10.0.0.1:99999", "not host:port"),
+])
+def test_validate_rejects_malformed_fleet_endpoints(bad, needle):
+    errs = validate.validate(render.render_all(
+        JobConfig(num_workers=2, fleet_endpoints=bad)))
+    assert any("TPUJOB_FLEET_ENDPOINTS" in e and needle in e for e in errs)
+
+
+# ------------------------------------------------------------ chaos case
+
+class _Replica:
+    """One live in-process serving replica: a real exporter over a real
+    registry fed by ServingStats through bridge.serving_collector, plus
+    the scheduler/heartbeat gauges the health score reads. Its loop runs
+    a fault-injection hook at the serve_decode site; while decode is
+    wedged the observable symptoms appear exactly as they would in the
+    engine (queue backs up, KV pins full, clients time out, heartbeat
+    goes stale)."""
+
+    def __init__(self, tenant="chat"):
+        self.registry = MetricsRegistry()
+        self.stats = ServingStats()
+        bridge.serving_collector(self.registry, self.stats)
+        self.queue = self.registry.gauge(
+            "sched_queue_depth", "queued per tenant", labelnames=("tenant",))
+        self.wait = self.registry.gauge(
+            "sched_queue_wait_p95_ms", "wait p95", labelnames=("tenant",))
+        self.hb_age = self.registry.gauge(
+            "tpujob_heartbeat_age_seconds", "hb age", labelnames=("rank",))
+        self.exporter = MetricsExporter(self.registry, host="127.0.0.1",
+                                        port=0).start()
+        self.addr = f"127.0.0.1:{self.exporter.port}"
+        self.tenant = tenant
+        self._stop = threading.Event()
+        self._thread = None
+
+    def start(self, injector):
+        def run():
+            last_beat = time.time()
+            while not self._stop.is_set():
+                t0 = time.time()
+                injector.fire("serve_decode")
+                stalled = time.time() - t0
+                now = time.time()
+                if stalled > 0.25:
+                    self.queue.labels(tenant=self.tenant).set(128.0)
+                    self.wait.labels(tenant=self.tenant).set(900.0)
+                    self.stats.record_kv_pool(100, 100, 0)
+                    for _ in range(25):
+                        self.stats.record_completion(stalled, 0, "timeout")
+                else:
+                    last_beat = now
+                    self.queue.labels(tenant=self.tenant).set(1.0)
+                    self.wait.labels(tenant=self.tenant).set(5.0)
+                    self.stats.record_kv_pool(100, 10, 0)
+                    self.stats.record_completion(0.01, 8, "eos")
+                self.hb_age.labels(rank="0").set(now - last_beat)
+                self._stop.wait(0.05)
+
+        self._thread = threading.Thread(target=run, daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+        self.exporter.stop()
+
+
+def test_chaos_decode_stall_drops_one_replica_and_fires_fast_alert():
+    """The PR's acceptance scenario, live end to end: two exporter
+    replicas, a serve_decode stall injected into ONE. Exactly that
+    replica's health must drop below the threshold and the tenant's
+    fast-window availability alert must fire — then clear once the fault
+    window ends and good traffic ages the bad events out."""
+    plan = FaultPlan(faults=(Fault(site="serve_decode", action="stall",
+                                   seconds=0.5, after=5, count=4),))
+    faulted, healthy = _Replica(), _Replica()
+    events = []
+    engine = SLOEngine(
+        {"chat": SLOTarget(availability=0.99, window_s=24.0)},  # fast = 2 s
+        emit=lambda event, **f: events.append((event, f)))
+    scraper = FleetScraper([faulted.addr, healthy.addr], timeout_s=2.0)
+    agg = FleetAggregator(scraper,
+                          policy=HealthPolicy(heartbeat_stale_s=0.5))
+    healthy_scores = []
+
+    def poll_once():
+        scraper.poll()
+        fleet_mod.feed_slo(engine, agg)
+        engine.evaluate()
+        reports = agg.health_reports()
+        healthy_scores.append(reports[healthy.addr].score)
+        return reports
+
+    def fast_events(kind):
+        return [f for e, f in events
+                if e == kind and f["window"] == "fast"
+                and f["sli"] == "availability"]
+
+    inj = FaultInjector(plan, rank=0)
+    try:
+        faulted.start(inj)
+        healthy.start(FaultInjector(FaultPlan(), rank=0))
+        saw_unhealthy = False
+        deadline = time.time() + 20.0
+        while time.time() < deadline:
+            reports = poll_once()
+            saw_unhealthy |= not reports[faulted.addr].healthy
+            if saw_unhealthy and fast_events("slo_alert"):
+                break
+            time.sleep(0.05)
+        assert saw_unhealthy, "faulted replica never dropped below threshold"
+        alert = fast_events("slo_alert")
+        assert alert and alert[0]["tenant"] == "chat"
+        assert alert[0]["burn_rate"] > alert[0]["threshold"] == 14.4
+        # The stall really came from the injector, not the harness.
+        assert ("serve_decode", "stall") in inj.fired
+        # Recovery: fault window over, good traffic ages bad events out
+        # of the 2 s fast window and the heartbeat/queue gauges reset.
+        deadline = time.time() + 25.0
+        healthy_again = recovered = False
+        while time.time() < deadline and not (healthy_again and recovered):
+            reports = poll_once()
+            healthy_again = reports[faulted.addr].healthy
+            recovered = bool(fast_events("slo_recovered"))
+            time.sleep(0.05)
+        assert healthy_again, "faulted replica never recovered"
+        assert recovered, "fast-window alert never cleared"
+        # Blast radius: the healthy replica stayed green through the
+        # entire run — the stall must not smear across replicas.
+        assert min(healthy_scores) >= 0.5
+        assert all(e != "slo_alert" or f["tenant"] == "chat"
+                   for e, f in events)
+    finally:
+        faulted.stop()
+        healthy.stop()
