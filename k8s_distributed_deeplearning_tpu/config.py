@@ -128,6 +128,18 @@ class JobConfig:
     # as $TPUJOB_FLEET_ENDPOINTS (comma-separated host:port /metrics
     # targets) — telemetry/fleet.py scrapes them. None renders no env.
     fleet_endpoints: str | None = None
+    # Graceful-shutdown budget: pod terminationGracePeriodSeconds — the
+    # window between SIGTERM and SIGKILL that the serving drain (SIGTERM
+    # → finish in-flight → exit 0) and the training preemption
+    # checkpoint both run inside. None renders no field (k8s default 30s).
+    termination_grace_s: int | None = None
+    # preStop sleep: delay SIGTERM by this many seconds so the endpoint/
+    # gateway routing layer observes the pod leaving the ready set and
+    # stops sending NEW requests before the drain starts (the classic
+    # rolling-update race). Rendered as a lifecycle preStop exec sleep;
+    # must be < termination_grace_s (validate.py enforces). None/0 = no
+    # preStop hook.
+    pre_stop_sleep_s: int | None = None
 
     def chips_per_worker(self) -> int:
         """TPU chips each pod must request: the slice's chip total (product of

@@ -21,6 +21,11 @@ Sites (where the hook points live):
 - ``checkpoint_saved`` train loop, right after a checkpoint write completes
 - ``heartbeat``        gates ``HeartbeatWriter.beat`` in the train loop
 - ``serve_decode``     serving engine, before each decode iteration
+- ``gateway_dispatch`` serving gateway (``serve/gateway.py``), before it
+                       steps each replica — ``step`` carries the REPLICA
+                       INDEX, so a step-scoped fault targets exactly one
+                       replica of an in-process fleet (``ioerror`` = that
+                       replica's dispatch fails, ``stall`` = it straggles)
 - ``executor``         the PARENT gang executor (``launch/local_executor``):
                        kills worker *rank* from outside after *seconds* —
                        the kubelet/node-failure emulation
@@ -45,7 +50,7 @@ import dataclasses
 import json
 
 SITES = ("step", "data_wait", "shard_read", "checkpoint_saved", "heartbeat",
-         "serve_decode", "executor")
+         "serve_decode", "gateway_dispatch", "executor")
 ACTIONS = ("exit", "sigterm", "stall", "ioerror", "truncate", "corrupt",
            "stop")
 
@@ -58,6 +63,7 @@ _SITE_ACTIONS = {
     "checkpoint_saved": ("truncate", "corrupt", "exit"),
     "heartbeat": ("stop",),
     "serve_decode": ("stall", "exit", "sigterm"),
+    "gateway_dispatch": ("ioerror", "stall", "exit", "sigterm"),
     "executor": ("exit", "sigterm"),
 }
 

@@ -66,6 +66,17 @@ def main(argv: list[str] | None = None) -> int:
                  "scraped each watch poll — replicas whose composite "
                  "health score drops below threshold are reported "
                  "(telemetry.fleet)")
+        p.add_argument(
+            "--termination-grace-s", type=int, default=d.termination_grace_s,
+            help="pod terminationGracePeriodSeconds: the SIGTERM→SIGKILL "
+                 "window the serving drain / preemption checkpoint runs "
+                 "inside (default: omit the field, i.e. the k8s 30s)")
+        p.add_argument(
+            "--pre-stop-sleep-s", type=int, default=d.pre_stop_sleep_s,
+            help="render a preStop exec hook sleeping this many seconds "
+                 "before SIGTERM, letting the routing layer stop sending "
+                 "new requests first; must be < the termination grace "
+                 "period (validate enforces)")
     parsers["render"].add_argument(
         "--apply", action="store_true",
         help="pipe the manifests into kubectl apply -f -")
@@ -108,7 +119,9 @@ def main(argv: list[str] | None = None) -> int:
                     tpu_topology=args.tpu_topology,
                     tpu_accelerator=args.tpu_accelerator,
                     cpu=args.cpu, memory=args.memory,
-                    fleet_endpoints=args.fleet_endpoints)
+                    fleet_endpoints=args.fleet_endpoints,
+                    termination_grace_s=args.termination_grace_s,
+                    pre_stop_sleep_s=args.pre_stop_sleep_s)
     docs = render.render_all(cfg)
     text = render.to_yaml(docs)
 

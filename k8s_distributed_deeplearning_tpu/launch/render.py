@@ -91,6 +91,16 @@ def render_tpujob(cfg: JobConfig) -> dict:
                        "google.com/tpu": str(cfg.chips_per_worker())},
         },
     }
+    if cfg.pre_stop_sleep_s:
+        # Hold SIGTERM back while the routing layer (Service endpoints /
+        # the serving gateway) notices the pod leaving the ready set —
+        # otherwise new requests race the drain and get shed instead of
+        # served. After the sleep, kubelet delivers SIGTERM and the
+        # worker's drain handshake (serve/cli.py) runs inside the
+        # remaining terminationGracePeriodSeconds.
+        container["lifecycle"] = {
+            "preStop": {"exec": {"command":
+                ["/bin/sh", "-c", f"sleep {int(cfg.pre_stop_sleep_s)}"]}}}
     return {
         "apiVersion": "batch/v1",
         "kind": "Job",
@@ -122,6 +132,13 @@ def render_tpujob(cfg: JobConfig) -> dict:
                 "spec": {
                     "subdomain": cfg.name,           # joins the headless svc
                     "restartPolicy": "OnFailure",
+                    # SIGTERM→SIGKILL window for the drain / preemption-
+                    # checkpoint handshake; must cover the preStop sleep
+                    # PLUS the worst-case drain (validate.py checks the
+                    # ordering). Omitted = k8s default (30s).
+                    **({"terminationGracePeriodSeconds":
+                        int(cfg.termination_grace_s)}
+                       if cfg.termination_grace_s is not None else {}),
                     "nodeSelector": {
                         "cloud.google.com/gke-tpu-accelerator": cfg.tpu_accelerator,
                         "cloud.google.com/gke-tpu-topology": cfg.tpu_topology,

@@ -178,6 +178,44 @@ def _check_fleet_endpoints(errors, where: str, c: dict) -> None:
                      f"{entry!r} is not host:port with a valid port")
 
 
+_PRESTOP_SLEEP = re.compile(r"\bsleep\s+(\d+)\b")
+
+
+def _check_termination(errors, where: str, tmpl: dict,
+                       containers: list[dict]) -> None:
+    """The graceful-shutdown contract: terminationGracePeriodSeconds must
+    be a positive integer, and any preStop sleep must FIT inside it with
+    room left for the actual drain. kubelet starts the grace clock when
+    termination begins — the preStop hook runs inside it, so a sleep >=
+    the grace period means SIGTERM arrives with zero (or negative) drain
+    budget and the pod dies mid-request anyway; that mistake validates
+    fine against the k8s schema and only shows up as lost requests during
+    the first rolling update."""
+    grace = tmpl.get("terminationGracePeriodSeconds")
+    if grace is not None and (not isinstance(grace, int) or grace < 1):
+        _err(errors, where, f"terminationGracePeriodSeconds {grace!r} must "
+             "be a positive integer")
+        grace = None
+    effective_grace = grace if grace is not None else 30   # k8s default
+    for c in containers:
+        hook = ((c.get("lifecycle") or {}).get("preStop") or {})
+        if not hook:
+            continue
+        cmd = (hook.get("exec") or {}).get("command")
+        if not cmd:
+            _err(errors, where, "preStop hook without an exec command "
+                 "(only exec preStop hooks are rendered/supported)")
+            continue
+        m = _PRESTOP_SLEEP.search(" ".join(str(a) for a in cmd))
+        if m and int(m.group(1)) >= effective_grace:
+            _err(errors, where,
+                 f"preStop sleep ({m.group(1)}s) >= termination grace "
+                 f"period ({effective_grace}s"
+                 f"{' default' if grace is None else ''}) — SIGTERM would "
+                 "arrive with no drain budget left; raise "
+                 "terminationGracePeriodSeconds or shrink the sleep")
+
+
 def validate(docs: list[dict]) -> list[str]:
     """Validate rendered manifests; returns a list of errors (empty = OK)."""
     errors: list[str] = []
@@ -218,6 +256,7 @@ def validate(docs: list[dict]) -> list[str]:
             _err(errors, where, "no containers in pod template")
         for c in containers:
             _check_container(errors, where, c)
+        _check_termination(errors, where, tmpl, containers)
 
         # The distributed-bootstrap contract (what a typo here costs: every
         # pod hangs in jax.distributed.initialize at startup).

@@ -11,15 +11,16 @@ slots re-admitted in flight (Orca-style iteration scheduling). See
 :mod:`serve.engine` for the design contract.
 """
 from k8s_distributed_deeplearning_tpu.serve.engine import ServeEngine
+from k8s_distributed_deeplearning_tpu.serve.gateway import ServeGateway
 from k8s_distributed_deeplearning_tpu.serve.page_pool import PagePool
 from k8s_distributed_deeplearning_tpu.serve.prefix_cache import PrefixCache
 from k8s_distributed_deeplearning_tpu.serve.request import (
-    QueueFull, Request, RequestOutput, SamplingParams)
+    EngineDraining, QueueFull, Request, RequestOutput, SamplingParams)
 from k8s_distributed_deeplearning_tpu.serve.sched import (
     DEFAULT_TENANT, TenantConfig, TenantScheduler, load_tenants)
 from k8s_distributed_deeplearning_tpu.serve.scheduler import RequestQueue
 
-__all__ = ["ServeEngine", "Request", "RequestOutput", "SamplingParams",
-           "RequestQueue", "QueueFull", "PagePool", "PrefixCache",
-           "TenantConfig", "TenantScheduler", "DEFAULT_TENANT",
-           "load_tenants"]
+__all__ = ["ServeEngine", "ServeGateway", "Request", "RequestOutput",
+           "SamplingParams", "RequestQueue", "QueueFull", "EngineDraining",
+           "PagePool", "PrefixCache", "TenantConfig", "TenantScheduler",
+           "DEFAULT_TENANT", "load_tenants"]

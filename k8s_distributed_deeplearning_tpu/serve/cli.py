@@ -29,6 +29,18 @@ def main(argv: list[str] | None = None) -> int:
                          "124M bench config)")
     ap.add_argument("--max-seq-len", type=int, default=512)
     ap.add_argument("--slots", type=int, default=8)
+    ap.add_argument("--replicas", type=int, default=1,
+                    help="run this many in-process engine replicas behind "
+                         "the failover gateway (serve/gateway.py): health-"
+                         "routed dispatch, per-replica circuit breakers, "
+                         "and in-flight migration off sick/draining "
+                         "replicas. 1 = a bare engine (no gateway)")
+    ap.add_argument("--hedge-after-s", type=float, default=None,
+                    metavar="S",
+                    help="gateway only: duplicate a request's dispatch on "
+                         "a second replica when its first token is still "
+                         "missing after S seconds (first stream wins, "
+                         "loser is cancelled); omitted = no hedging")
     ap.add_argument("--requests", type=int, default=32)
     ap.add_argument("--max-queue", type=int, default=None,
                     help="admission queue bound (default: number of "
@@ -115,6 +127,15 @@ def main(argv: list[str] | None = None) -> int:
     if args.debug_dir is not None and args.metrics_port is None:
         ap.error("--debug-dir requires --metrics-port (the debug surface "
                  "rides the metrics exporter)")
+    if args.replicas < 1:
+        ap.error(f"--replicas must be >= 1, got {args.replicas}")
+    if args.hedge_after_s is not None and args.replicas < 2:
+        ap.error("--hedge-after-s needs --replicas >= 2 (hedging "
+                 "duplicates a dispatch onto a PEER replica)")
+    if args.hedge_after_s is not None and args.hedge_after_s <= 0:
+        ap.error(f"--hedge-after-s must be > 0, got {args.hedge_after_s}")
+
+    import signal
 
     import jax
     import jax.numpy as jnp
@@ -124,8 +145,10 @@ def main(argv: list[str] | None = None) -> int:
     from k8s_distributed_deeplearning_tpu.serve import (QueueFull, Request,
                                                         SamplingParams,
                                                         ServeEngine,
+                                                        ServeGateway,
                                                         load_tenants)
-    from k8s_distributed_deeplearning_tpu.utils.metrics import MetricsLogger
+    from k8s_distributed_deeplearning_tpu.utils.metrics import (
+        MetricsLogger, ServingStats)
 
     tenant_cfgs = None
     if args.tenants:
@@ -164,15 +187,48 @@ def main(argv: list[str] | None = None) -> int:
         # span events on the JSONL stream.
         tracer = Tracer(logger if args.trace else None,
                         ring_size=512 if args.debug_dir is not None else 0)
-    engine = ServeEngine(
-        model, params, num_slots=args.slots,
-        max_queue=args.max_queue or args.requests,
-        eos_id=args.eos_id, tracer=tracer, tenants=tenant_cfgs,
-        prefill_chunk_tokens=args.prefill_chunk_tokens or None,
-        prefix_cache_mb=args.prefix_cache_mb or None,
-        kv_pool_pages=args.kv_pool_pages or None,
-        request_trace_sample=args.request_trace_sample,
-        request_log=logger)
+    # ONE ServingStats shared by every replica AND the gateway: replica
+    # activity and gateway counters aggregate into a single summary()/
+    # scrape surface (the process is single-threaded, so increment-only
+    # sharing is safe).
+    stats = ServingStats()
+    engines = [
+        ServeEngine(
+            model, params, num_slots=args.slots,
+            max_queue=args.max_queue or args.requests,
+            eos_id=args.eos_id, tracer=tracer, tenants=tenant_cfgs,
+            prefill_chunk_tokens=args.prefill_chunk_tokens or None,
+            prefix_cache_mb=args.prefix_cache_mb or None,
+            kv_pool_pages=args.kv_pool_pages or None,
+            request_trace_sample=args.request_trace_sample,
+            request_log=logger, stats=stats,
+            replica_id=f"r{i}" if args.replicas > 1 else None)
+        for i in range(args.replicas)]
+    engine = engines[0]
+    gateway = None
+    if args.replicas > 1:
+        gateway = ServeGateway(engines, stats=stats, logger=logger,
+                               hedge_after_s=args.hedge_after_s)
+    front = gateway if gateway is not None else engine
+
+    # SIGTERM → cooperative drain → exit 0: the k8s eviction handshake.
+    # The handler only flips drain mode (stop admitting); the serving
+    # loop below keeps stepping until everything held has finished, and
+    # /healthz reports {"draining": ..., "drained": ...} so a preStop
+    # hook can poll for safe-to-kill.
+    drain_requested = False
+
+    def _on_sigterm(signum, frame):
+        nonlocal drain_requested
+        drain_requested = True
+        for e in engines:
+            e.drain()
+
+    try:
+        signal.signal(signal.SIGTERM, _on_sigterm)
+    except ValueError:
+        pass              # not the main thread (embedded use): no handler
+
     exporter = None
     if args.metrics_port is not None:
         from k8s_distributed_deeplearning_tpu.telemetry import bridge
@@ -181,12 +237,20 @@ def main(argv: list[str] | None = None) -> int:
         from k8s_distributed_deeplearning_tpu.telemetry.registry import (
             MetricsRegistry)
         registry = MetricsRegistry()
-        bridge.serving_collector(registry, engine.stats)
-        bridge.sched_collector(registry, engine.queue)
+        bridge.serving_collector(registry, stats)
+        if gateway is not None:
+            bridge.gateway_collector(registry, gateway)
+        else:
+            # Per-tenant labeled gauges are per-scheduler; with replicas
+            # each engine has its own and the labels would collide.
+            bridge.sched_collector(registry, engine.queue)
         exporter = MetricsExporter(
             registry, port=args.metrics_port,
             tracer=tracer if args.debug_dir is not None else None,
-            profile_dir=args.debug_dir).start()
+            profile_dir=args.debug_dir,
+            healthz=lambda: {
+                "draining": any(e.draining for e in engines),
+                "drained": all(e.drained for e in engines)}).start()
     shared = rng.integers(0, cfg.vocab_size, size=args.shared_prefix_len)
     tenant_ids = engine.queue.tenant_ids()
     from collections import deque
@@ -205,16 +269,18 @@ def main(argv: list[str] | None = None) -> int:
     # happen — the same loop a network front-end would run. Requests are
     # fed under back-pressure: a tenant whose bounded queue is full sheds
     # (logged) and the front end retries it after the next iteration.
-    while feed or engine.busy():
+    while feed or front.busy():
+        if drain_requested and feed:
+            feed.clear()        # draining: the unsubmitted tail is shed
         while feed:
             try:
-                engine.submit(feed[0])
+                front.submit(feed[0])
             except QueueFull:
                 logger.emit("sched_shed", tenant=feed[0].tenant,
                             request_id=feed[0].request_id, retried=True)
                 break
             feed.popleft()
-        for out in engine.step():
+        for out in front.step():
             logger.emit("serve_request", request_id=out.request_id,
                         prompt_len=out.prompt_len,
                         new_tokens=len(out.tokens),
@@ -224,12 +290,20 @@ def main(argv: list[str] | None = None) -> int:
                         ttft_ms=(round(out.ttft_s * 1e3, 3)
                                  if out.ttft_s is not None else None),
                         latency_ms=round(out.latency_s * 1e3, 3))
+    if drain_requested:
+        for e in engines:
+            logger.emit("replica_drained",
+                        replica=e.replica_id if e.replica_id is not None
+                        else "r0")
     logger.emit("serve_summary", num_slots=args.slots,
-                preset=args.preset, **engine.stats.summary())
+                preset=args.preset, replicas=args.replicas,
+                **stats.summary())
     if tenant_cfgs is not None:
-        snap = engine.queue.snapshot()
-        for tid, t in snap["tenants"].items():
-            logger.emit("sched_tenant_summary", tenant=tid, **t)
+        for e in engines:
+            snap = e.queue.snapshot()
+            for tid, t in snap["tenants"].items():
+                logger.emit("sched_tenant_summary", tenant=tid,
+                            replica=e.replica_id, **t)
     logger.close()
     if exporter is not None:
         exporter.stop()

@@ -88,7 +88,7 @@ from k8s_distributed_deeplearning_tpu.models import generate
 from k8s_distributed_deeplearning_tpu.serve.page_pool import PagePool
 from k8s_distributed_deeplearning_tpu.serve.prefix_cache import PrefixCache
 from k8s_distributed_deeplearning_tpu.serve.request import (
-    QueueFull, Request, RequestOutput)
+    EngineDraining, QueueFull, Request, RequestOutput)
 from k8s_distributed_deeplearning_tpu.serve.sched import (
     TenantConfig, TenantScheduler)
 from k8s_distributed_deeplearning_tpu.telemetry.trace import Tracer
@@ -292,7 +292,8 @@ class ServeEngine:
                  stats: ServingStats | None = None,
                  tracer: Tracer | None = None,
                  request_trace_sample: float = 0.0,
-                 request_log: "Any | None" = None):
+                 request_log: "Any | None" = None,
+                 replica_id: str | None = None):
         if num_slots < 2:
             raise ValueError(f"num_slots must be >= 2, got {num_slots}")
         cfg = getattr(model, "cfg", None)
@@ -338,6 +339,10 @@ class ServeEngine:
         self.request_trace_sample = float(request_trace_sample)
         self.request_log = (request_log if request_log is not None
                             else self.tracer.logger)
+        # Identity in a multi-replica deployment (gateway routing,
+        # request_trace replica= field). None for standalone engines.
+        self.replica_id = replica_id
+        self._draining = False
         self.queue = TenantScheduler(tenants, default_max_queue=max_queue)
         # Page geometry: the trie's block size IS the pool's page size
         # (one trie node = one page), and it applies whether or not the
@@ -438,11 +443,23 @@ class ServeEngine:
 
     # ---------------------------------------------------------------- API
 
-    def submit(self, req: Request) -> str:
+    def submit(self, req: Request, *, requeue: bool = False) -> str:
         """Queue a request under its tenant's policy. Raises QueueFull —
         scoped to the offending tenant — when that tenant's bounded queue
-        is at capacity, and ValueError for requests that could never run
-        (or that name an unregistered tenant)."""
+        is at capacity, EngineDraining once :meth:`drain` has been called,
+        and ValueError for requests that could never run (or that name an
+        unregistered tenant).
+
+        ``requeue=True`` is the migration path (gateway resubmission of a
+        request another replica already admitted): the request enters at
+        the HEAD of its deadline class with its original ``_t_submit``
+        (hence ``deadline_abs``) preserved and its token-bucket/DRR cost
+        already paid — see :meth:`serve.sched.TenantScheduler.requeue`."""
+        if self._draining:
+            raise EngineDraining(
+                f"engine{f' {self.replica_id!r}' if self.replica_id else ''}"
+                f" is draining — admitting nothing new "
+                f"(request {req.request_id})")
         n = len(req.prompt)
         if n < 1:
             raise ValueError("empty prompt")
@@ -460,9 +477,13 @@ class ServeEngine:
                 f"request needs {need} KV pages but the pool only has "
                 f"{self.pool.num_pages - 1} — raise kv_pool_pages or "
                 "lower max_new_tokens")
-        req._t_submit = time.perf_counter()
+        if not requeue or req._t_submit is None:
+            req._t_submit = time.perf_counter()
         req._finished = False        # re-arm the exactly-once on_finish latch
-        self.queue.submit(req)
+        if requeue:
+            self.queue.requeue(req)
+        else:
+            self.queue.submit(req)
         return req.request_id
 
     def busy(self) -> bool:
@@ -472,6 +493,74 @@ class ServeEngine:
         entry, so checking queue+slots alone would exit early)."""
         return bool(len(self.queue) or self._pending
                     or any(s is not None for s in self._slots))
+
+    def occupied_slots(self) -> int:
+        """Decode slots currently running a request (excludes pending
+        prefills — they hold a reservation, not a decode row)."""
+        return sum(s is not None for s in self._slots)
+
+    def load(self) -> int:
+        """Queued + mid-prefill + decoding request count — the gateway's
+        least-loaded routing key."""
+        return (len(self.queue) + len(self._pending)
+                + self.occupied_slots())
+
+    def drain(self, *, flush: bool = False) -> list[Request]:
+        """Enter cooperative drain mode: stop admitting (further
+        :meth:`submit` calls raise :class:`EngineDraining`) while
+        :meth:`step` keeps finishing what the engine already holds —
+        the SIGTERM → drain → exit-0 shape for k8s rolling updates.
+
+        ``flush=True`` additionally hands the still-QUEUED requests back
+        (removed from the queue, untouched otherwise) so a gateway can
+        migrate them to a peer instead of waiting for this replica to
+        serve them; without a peer list, leave ``flush=False`` and the
+        queue drains through the normal admission path. Idempotent."""
+        self._draining = True
+        return self.queue.drain() if flush else []
+
+    @property
+    def draining(self) -> bool:
+        """True once :meth:`drain` has been called (no new admissions)."""
+        return self._draining
+
+    @property
+    def drained(self) -> bool:
+        """True when drain mode is on AND no work remains — the
+        ``/healthz`` signal a preStop hook (or the gateway) polls before
+        letting the pod die."""
+        return self._draining and not self.busy()
+
+    def cancel(self, request_id: str, reason: str = "aborted"
+               ) -> RequestOutput | None:
+        """Cancel ONE request wherever it currently lives — queued
+        (removed, no tokens), mid-prefill (pinned trie segments released,
+        pages freed) or decoding (partial tokens, slot freed) — and
+        complete it with *reason*. The per-request surface behind gateway
+        migration (reason "migrated") and hedge loser cancellation.
+        Returns the terminal output, or None for an unknown/already-
+        finished request id."""
+        remove = getattr(self.queue, "remove", None)
+        req = remove(request_id) if remove is not None else None
+        if req is not None:
+            now = time.perf_counter()
+            t0 = req._t_submit if req._t_submit is not None else now
+            out = RequestOutput(
+                request_id=req.request_id, prompt_len=len(req.prompt),
+                tokens=[], finish_reason=reason, queue_s=now - t0,
+                ttft_s=None, latency_s=now - t0)
+            self.stats.record_completion(latency_s=out.latency_s,
+                                         n_tokens=0, reason=reason)
+            self._emit_request_trace(req, out)
+            self._notify_finish(req, reason)
+            return out
+        for slot in list(self._pending):
+            if self._pending[slot].req.request_id == request_id:
+                return self._cancel_pending(slot, reason)
+        for slot, fl in enumerate(self._slots):
+            if fl is not None and fl.req.request_id == request_id:
+                return self._finish(slot, reason)
+        return None
 
     def step(self) -> list[RequestOutput]:
         """One serving iteration: admit queued requests into free slots
@@ -699,6 +788,8 @@ class ServeEngine:
         self.request_log.emit(
             "request_trace",
             request_id=out.request_id,
+            replica=self.replica_id,
+            migrated_from=req.migrated_from,
             tenant=req.tenant,
             priority=priority(req.tenant) if priority is not None else None,
             prompt_len=out.prompt_len,

@@ -20,6 +20,12 @@ class QueueFull(RuntimeError):
     surfaces to the caller instead of growing memory without bound)."""
 
 
+class EngineDraining(RuntimeError):
+    """The engine is in drain mode (``ServeEngine.drain``): it finishes
+    what it holds but admits nothing new. Routers treat this as a
+    permanent per-replica rejection — send the request elsewhere."""
+
+
 @dataclasses.dataclass(frozen=True)
 class SamplingParams:
     """Per-request sampling knobs. Defaults are greedy decoding."""
@@ -71,6 +77,11 @@ class Request:
     # streaming client learns "timeout"/"aborted" even though on_token
     # will never fire again.
     on_finish: Callable[[str], None] | None = None
+    # Replica id this request was migrated away from (stamped by the
+    # gateway on a :meth:`resume_from_tokens` resubmission; carried into
+    # the request_trace so a request's lifecycle is visible across
+    # replicas). None for first-dispatch requests.
+    migrated_from: str | None = None
     # Stamped by ServeEngine.submit (perf_counter clock); queue wait and
     # TTFT are measured from this instant.
     _t_submit: float | None = dataclasses.field(
@@ -80,6 +91,37 @@ class Request:
     # fire the terminal callback twice.
     _finished: bool = dataclasses.field(
         default=False, repr=False, compare=False)
+    # Set by TenantScheduler.requeue (gateway migration): this request was
+    # already admitted once and billed at its first pop, so the next pop
+    # takes it from the queue HEAD without charging its tenant's token
+    # bucket or DRR deficit again.
+    _requeued: bool = dataclasses.field(
+        default=False, repr=False, compare=False)
+
+    def resume_from_tokens(self, emitted: Sequence[int], *,
+                           migrated_from: str | None = None) -> "Request":
+        """The migration resubmission: a request whose stream already
+        emitted *emitted* tokens continues on another replica as
+        ``prompt + emitted`` with the decode budget reduced by what was
+        already streamed — exactly a prefix workload for the target's
+        paged trie, and (under greedy sampling) token-identical to the
+        uninterrupted run. Identity (``request_id``, ``seed``, tenant,
+        deadline, submit timestamp) is preserved so dedup-by-request-id,
+        EDF deadlines and rate accounting all see ONE request; callbacks
+        carry over (callers installing per-dispatch closures — the
+        gateway — overwrite them) and the ``on_finish`` latch re-arms at
+        the next submit."""
+        emitted = list(emitted)
+        if len(emitted) >= self.max_new_tokens:
+            raise ValueError(
+                f"request {self.request_id} already emitted {len(emitted)} "
+                f"of {self.max_new_tokens} tokens — nothing left to resume")
+        return dataclasses.replace(
+            self,
+            prompt=list(self.prompt) + emitted,
+            max_new_tokens=self.max_new_tokens - len(emitted),
+            migrated_from=migrated_from,
+            _finished=False, _requeued=False)
 
 
 @dataclasses.dataclass

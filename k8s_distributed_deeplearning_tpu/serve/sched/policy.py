@@ -99,6 +99,12 @@ class _TenantState:
             return True
         if cfg.rate_tokens_per_s is not None:
             self.refill(now)
+            # Requeued (migrated) heads already paid their token cost at
+            # their FIRST pop — a rate block here would double-bill the
+            # failover. Slot quota above still applies: migration moves a
+            # request, it does not mint extra concurrency.
+            if self.heap[0][2]._requeued:
+                return False
             # Oversized requests (cost > burst) admit on a full bucket and
             # drive it into debt — they pay their true cost in wait time
             # instead of starving forever.
@@ -146,6 +152,12 @@ class TenantScheduler:
             for cls in PRIORITY_CLASSES}
         self._rr: dict[str, int] = {cls: 0 for cls in PRIORITY_CLASSES}
         self._seq = itertools.count()
+        # Head-of-line sequence for requeued (migrated) requests: negative
+        # and descending, so among equal deadlines a requeue sorts before
+        # every normal submit AND before earlier requeues of other
+        # requests (LIFO among requeues — the most recently displaced
+        # request has waited longest overall).
+        self._rseq = itertools.count(-1, -1)
         self._n = 0
 
     # ------------------------------------------------------------- submit
@@ -175,6 +187,49 @@ class TenantScheduler:
               if req.deadline_s is not None else math.inf)
         heapq.heappush(ts.heap, (dl, next(self._seq), req))
         self._n += 1
+
+    def requeue(self, req: Request) -> None:
+        """Re-enqueue a request another replica already admitted and then
+        had to give back (gateway migration / replica drain) AT THE HEAD
+        of its deadline class: the original ``deadline_abs`` is preserved
+        (``_t_submit`` was stamped at the first submit and carries over),
+        the tenant's token bucket is NOT re-charged at the next pop (the
+        first pop already billed the full prompt+decode cost), and the
+        ``max_queue`` bound is bypassed — shedding a request we promised
+        to migrate would turn a replica failure into a client-visible
+        loss. Raises ValueError for an unknown tenant (same contract as
+        :meth:`submit`)."""
+        tid = req.tenant or DEFAULT_TENANT
+        ts = self._tenants.get(tid)
+        if ts is None:
+            raise ValueError(
+                f"unknown tenant {tid!r} (registered: "
+                f"{sorted(self._tenants)}) — requests must name a "
+                "configured tenant")
+        if req._t_submit is None:
+            req._t_submit = self._clock()
+        req._requeued = True
+        dl = (req._t_submit + req.deadline_s
+              if req.deadline_s is not None else math.inf)
+        heapq.heappush(ts.heap, (dl, next(self._rseq), req))
+        self._n += 1
+
+    def remove(self, request_id: str) -> Request | None:
+        """Remove one queued request by id (gateway hedge-loser cancel /
+        per-request migration), or None when it is not queued. O(n) scan
+        + heapify of the owning tenant's heap — cancellation is the rare
+        path; the pop path stays O(log n)."""
+        for ts in self._tenants.values():
+            for i, (_, _, req) in enumerate(ts.heap):
+                if req.request_id == request_id:
+                    ts.heap[i] = ts.heap[-1]
+                    ts.heap.pop()
+                    heapq.heapify(ts.heap)
+                    self._n -= 1
+                    if not ts.heap:
+                        ts.deficit = 0.0
+                    return req
+        return None
 
     # ---------------------------------------------------------------- pop
 
@@ -207,10 +262,16 @@ class TenantScheduler:
                 return None         # resource-blocked: defer in place
             _, _, req = heapq.heappop(ts.heap)
             self._n -= 1
-            cost = _cost(req)
-            ts.deficit -= cost
-            if ts.cfg.rate_tokens_per_s is not None:
-                ts.tokens -= cost
+            if req._requeued:
+                # Migrated request: its first pop paid the full service
+                # cost (deficit + rate tokens); this pop is the prepaid
+                # continuation, not a second admission.
+                req._requeued = False
+            else:
+                cost = _cost(req)
+                ts.deficit -= cost
+                if ts.cfg.rate_tokens_per_s is not None:
+                    ts.tokens -= cost
             if not ts.heap:
                 ts.deficit = 0.0    # classic DRR: an emptied queue forfeits
             ts.in_flight += 1
@@ -241,7 +302,9 @@ class TenantScheduler:
                 ts = ring[(start + i) % n]
                 if not ts.heap or ts.blocked(now):
                     continue
-                cost = _cost(ts.heap[0][2])
+                head = ts.heap[0][2]
+                # A requeued head is deficit-free (billed at first pop).
+                cost = 0.0 if head._requeued else _cost(head)
                 if ts.deficit >= cost:
                     return ts, (start + i) % n
                 needed.append((cost, ts))

@@ -52,6 +52,24 @@ class RequestQueue:
             return None
         return self._q.popleft()
 
+    def requeue(self, req: Request) -> None:
+        """Head re-entry for a migrated request (same contract as
+        :meth:`serve.sched.TenantScheduler.requeue`): it jumps the FIFO —
+        it already waited its turn on the replica that failed — and the
+        capacity bound is bypassed, because shedding a request mid-
+        migration turns a replica failure into a client-visible loss."""
+        req._requeued = True
+        self._q.appendleft(req)
+
+    def remove(self, request_id: str) -> Request | None:
+        """Remove one queued request by id (hedge-loser cancel), or None
+        when it is not queued."""
+        for req in self._q:
+            if req.request_id == request_id:
+                self._q.remove(req)
+                return req
+        return None
+
     def sweep_expired(self, now: float | None = None) -> list[Request]:
         """FCFS keeps no deadline index: expired requests are detected at
         pop time instead (the engine's backstop check)."""

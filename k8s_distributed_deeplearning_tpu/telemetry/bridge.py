@@ -144,6 +144,19 @@ def serving_collector(registry: MetricsRegistry,
         "serve_kv_pages_shared": registry.gauge(
             "serve_kv_pages_shared",
             "KV pool pages with >= 2 holders (copy-free prefix sharing)"),
+        "serve_gateway_dispatches_total": registry.gauge(
+            "serve_gateway_dispatches_total",
+            "gateway request placements onto a replica (first dispatch, "
+            "migration resubmits and hedges included)"),
+        "serve_gateway_migrations_total": registry.gauge(
+            "serve_gateway_migrations_total",
+            "in-flight requests migrated off a tripped/draining replica"),
+        "serve_gateway_hedges_total": registry.gauge(
+            "serve_gateway_hedges_total",
+            "speculative duplicate dispatches for straggling prefills"),
+        "serve_gateway_breaker_trips_total": registry.gauge(
+            "serve_gateway_breaker_trips_total",
+            "per-replica circuit breaker open transitions"),
     }
     finished = registry.gauge(
         "serve_finished_total",
@@ -167,7 +180,11 @@ def serving_collector(registry: MetricsRegistry,
                "request_traces_sampled": "serve_request_traces_sampled",
                "kv_pages_total": "serve_kv_pages_total",
                "kv_pages_used": "serve_kv_pages_used",
-               "kv_pages_shared": "serve_kv_pages_shared"}
+               "kv_pages_shared": "serve_kv_pages_shared",
+               "gateway_dispatches": "serve_gateway_dispatches_total",
+               "gateway_migrations": "serve_gateway_migrations_total",
+               "gateway_hedges": "serve_gateway_hedges_total",
+               "gateway_breaker_trips": "serve_gateway_breaker_trips_total"}
 
     def collect() -> None:
         summ = stats.summary()
@@ -225,6 +242,47 @@ def sched_collector(registry: MetricsRegistry, sched) -> None:
             c_depth.labels(priority=cls).set(c["queue_depth"])
             if c["queue_wait_p95_ms"] is not None:
                 c_wait.labels(priority=cls).set(c["queue_wait_p95_ms"])
+
+    registry.register_collector(collect)
+
+
+def gateway_collector(registry: MetricsRegistry, gateway) -> None:
+    """Register a pull-time collector over the failover gateway's
+    :meth:`serve.gateway.ServeGateway.snapshot`: per-replica breaker
+    state (0 closed / 1 half-open / 2 open), health score, load and
+    drain progress. The aggregate gateway counters ride
+    :func:`serving_collector` (the stats object is shared), so this adds
+    only the per-replica dimension."""
+    state_code = {"closed": 0.0, "half_open": 1.0, "open": 2.0}
+    r_state = registry.gauge(
+        "serve_gateway_breaker_state",
+        "replica breaker state: 0=closed, 1=half_open, 2=open",
+        labelnames=("replica",))
+    r_health = registry.gauge(
+        "serve_gateway_replica_health",
+        "gateway-side composite health score per replica (0..1)",
+        labelnames=("replica",))
+    r_load = registry.gauge(
+        "serve_gateway_replica_load",
+        "queued + mid-prefill + decoding requests per replica",
+        labelnames=("replica",))
+    r_draining = registry.gauge(
+        "serve_gateway_replica_draining",
+        "1 while a replica is draining (0 otherwise); drops back to the "
+        "routing set never happen — drain is terminal",
+        labelnames=("replica",))
+    live = registry.gauge(
+        "serve_gateway_live_requests",
+        "client requests the gateway currently owns")
+
+    def collect() -> None:
+        snap = gateway.snapshot()
+        for rid, r in snap["replicas"].items():
+            r_state.labels(replica=rid).set(state_code.get(r["state"], 2.0))
+            r_health.labels(replica=rid).set(r["health"])
+            r_load.labels(replica=rid).set(r["load"])
+            r_draining.labels(replica=rid).set(1.0 if r["draining"] else 0.0)
+        live.set(snap["live_requests"])
 
     registry.register_collector(collect)
 

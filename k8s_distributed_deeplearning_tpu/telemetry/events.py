@@ -50,6 +50,16 @@ EVENTS: dict[str, str] = {
                      "rate dropped back under threshold",
     "fleet_scrape_failed": "a fleet replica stopped answering /metrics "
                            "(one event per failure episode, not per poll)",
+    "gateway_migrated": "the serving gateway moved one in-flight request "
+                        "off a tripped/draining replica (from/to replica "
+                        "and the emitted-token cursor attached)",
+    "gateway_breaker_open": "a replica's circuit breaker tripped: its "
+                            "requests are being migrated and dispatch "
+                            "stops until the half-open probe",
+    "gateway_breaker_closed": "a half-open probe succeeded: the replica "
+                              "is back in the routing set",
+    "replica_drained": "a draining replica finished or migrated all of "
+                       "its work (safe to terminate)",
 }
 
 _SNAKE = re.compile(r"^[a-z][a-z0-9_]*$")

@@ -127,6 +127,13 @@ class ServingStats:
         self.kv_pages_total = 0
         self.kv_pages_used = 0
         self.kv_pages_shared = 0
+        # Failover gateway (serve/gateway.py): request dispatches to a
+        # replica, in-flight migrations off sick/draining replicas,
+        # speculative hedge dispatches, and circuit-breaker trips.
+        self.gateway_dispatches = 0
+        self.gateway_migrations = 0
+        self.gateway_hedges = 0
+        self.gateway_breaker_trips = 0
 
     def _tick(self) -> None:
         now = time.perf_counter()
@@ -180,6 +187,29 @@ class ServingStats:
         self.kv_pages_used = int(pages_used)
         self.kv_pages_shared = int(pages_shared)
 
+    def record_gateway_dispatch(self) -> None:
+        """One gateway request dispatch (first placement, a migration
+        resubmit, or a hedge) landed on a replica."""
+        self._tick()
+        self.gateway_dispatches += 1
+
+    def record_gateway_migration(self) -> None:
+        """One live request was migrated off a tripped/draining replica
+        and resubmitted (prompt + emitted tokens) to a healthy one."""
+        self._tick()
+        self.gateway_migrations += 1
+
+    def record_gateway_hedge(self) -> None:
+        """One speculative duplicate dispatch for a straggling prefill."""
+        self._tick()
+        self.gateway_hedges += 1
+
+    def record_gateway_breaker_trip(self) -> None:
+        """One per-replica circuit breaker opened (consecutive dispatch
+        failures or a failed half-open probe)."""
+        self._tick()
+        self.gateway_breaker_trips += 1
+
     def record_completion(self, latency_s: float, n_tokens: int,
                           reason: str) -> None:
         self._tick()
@@ -228,6 +258,10 @@ class ServingStats:
             "kv_pages_used": self.kv_pages_used,
             "kv_pages_shared": self.kv_pages_shared,
             "request_traces_sampled": self.request_traces,
+            "gateway_dispatches": self.gateway_dispatches,
+            "gateway_migrations": self.gateway_migrations,
+            "gateway_hedges": self.gateway_hedges,
+            "gateway_breaker_trips": self.gateway_breaker_trips,
             # Fraction of looked-up prompt tokens served from cached KV
             # (None until the first lookup, i.e. cache disabled or idle).
             "prefix_hit_rate": (

@@ -57,9 +57,13 @@ CHUNKS[graftscope]="tests/test_graftscope.py"
 # tests plus the chaos case's two live in-process exporter replicas —
 # real (small) sleeps, so it gets its own chunk.
 CHUNKS[fleet]="tests/test_fleet.py"
+# Failover gateway chaos matrix (serve/gateway.py): multi-replica engines
+# compiling their own tiny models plus breaker-timing sleeps — its own
+# chunk so serve/sched stay under their timeouts.
+CHUNKS[gateway]="tests/test_gateway.py"
 CHUNKS[slow1]="tests/test_train_e2e.py tests/test_multiprocess.py"
 CHUNKS[slow2]="tests/test_multihost_train.py tests/test_multihost_llama.py tests/test_train_zoo.py"
-ORDER=(lint core parallel1 parallel2 moe train llama deploy serve sched paged faults graftscope fleet slow1 slow2)
+ORDER=(lint core parallel1 parallel2 moe train llama deploy serve sched paged faults graftscope fleet gateway slow1 slow2)
 
 # --- completeness check: every tests/test_*.py in EXACTLY one chunk ------
 # ...and every declared chunk actually in ORDER: a chunk missing from the

@@ -227,3 +227,50 @@ def test_invalid_tenants_fail_validation():
             JobConfig(num_workers=2, tenants=bad)))
         assert any("TPUJOB_TENANTS" in e and "not a valid" in e
                    for e in errs), (bad, errs)
+
+
+def test_graceful_shutdown_renders_prestop_and_grace():
+    """The serving-drain handshake as manifest fields: pre_stop_sleep_s
+    renders an exec preStop hook (routing layer notices the pod leaving
+    the ready set), termination_grace_s renders the SIGTERM->SIGKILL
+    window the drain runs inside, and a sane pair validates clean."""
+    from k8s_distributed_deeplearning_tpu.launch import validate
+
+    docs = render.render_all(JobConfig(num_workers=2, termination_grace_s=120,
+                                       pre_stop_sleep_s=10))
+    tmpl = docs[2]["spec"]["template"]["spec"]
+    assert tmpl["terminationGracePeriodSeconds"] == 120
+    cmd = tmpl["containers"][0]["lifecycle"]["preStop"]["exec"]["command"]
+    assert cmd == ["/bin/sh", "-c", "sleep 10"]
+    assert validate.validate(docs) == []
+    # Defaults: neither field renders (k8s defaults apply, no hook).
+    tmpl = render.render_all(JobConfig(num_workers=2))[2][
+        "spec"]["template"]["spec"]
+    assert "terminationGracePeriodSeconds" not in tmpl
+    assert "lifecycle" not in tmpl["containers"][0]
+    # A grace period alone (preemption checkpoint window) also validates.
+    assert validate.validate(render.render_all(
+        JobConfig(num_workers=2, termination_grace_s=300))) == []
+
+
+def test_prestop_sleep_must_fit_inside_grace_period():
+    """sleep >= grace means SIGTERM arrives with zero drain budget — a
+    manifest that passes the k8s schema and loses requests on the first
+    rolling update. Offline validation catches it, including against the
+    implicit 30s default when no grace period is set."""
+    from k8s_distributed_deeplearning_tpu.launch import validate
+
+    errs = validate.validate(render.render_all(
+        JobConfig(num_workers=2, termination_grace_s=15,
+                  pre_stop_sleep_s=15)))
+    assert any("preStop sleep" in e and "drain budget" in e for e in errs)
+    # No explicit grace: the k8s default (30s) is the budget the sleep
+    # must fit inside.
+    errs = validate.validate(render.render_all(
+        JobConfig(num_workers=2, pre_stop_sleep_s=45)))
+    assert any("30s default" in e for e in errs)
+    # Nonpositive grace is rejected outright (0 renders and fails: an
+    # explicit zero-second drain window is a config bug, not a default).
+    errs = validate.validate(render.render_all(
+        JobConfig(num_workers=2, termination_grace_s=0)))
+    assert any("must be a positive integer" in e for e in errs)
