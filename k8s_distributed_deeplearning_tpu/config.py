@@ -133,6 +133,13 @@ class JobConfig:
     # → finish in-flight → exit 0) and the training preemption
     # checkpoint both run inside. None renders no field (k8s default 30s).
     termination_grace_s: int | None = None
+    # Speculative decoding for serving workers: draft preset name carried
+    # as $TPUJOB_DRAFT_MODEL and the per-slot draft count as
+    # $TPUJOB_SPEC_K (serve/cli.py consumes the equivalent flags). Both
+    # or neither — validate.py enforces the pairing and the integer
+    # domain offline, before anything is applied to a cluster.
+    draft_model: str | None = None
+    spec_k: int | None = None
     # preStop sleep: delay SIGTERM by this many seconds so the endpoint/
     # gateway routing layer observes the pod leaving the ready set and
     # stops sending NEW requests before the drain starts (the classic

@@ -78,6 +78,14 @@ def render_tpujob(cfg: JobConfig) -> dict:
         # which replica /metrics endpoints to scrape and health-score.
         env.append({"name": "TPUJOB_FLEET_ENDPOINTS",
                     "value": cfg.fleet_endpoints})
+    # Speculative decoding for serving workers: draft preset + per-slot
+    # draft count (serve/cli.py --draft-model/--spec-k). Each half
+    # renders independently so a dangling one is VISIBLE in the manifest
+    # — validate.py enforces the pairing and integer domain offline.
+    if cfg.draft_model is not None:
+        env.append({"name": "TPUJOB_DRAFT_MODEL", "value": cfg.draft_model})
+    if cfg.spec_k is not None:
+        env.append({"name": "TPUJOB_SPEC_K", "value": str(cfg.spec_k)})
     container = {
         "name": "worker",
         "image": cfg.image,

@@ -72,6 +72,7 @@ def _check_container(errors, where: str, c: dict) -> None:
     _check_fault_plan(errors, where, c)
     _check_tenants(errors, where, c)
     _check_fleet_endpoints(errors, where, c)
+    _check_spec(errors, where, c)
 
 
 def _hooked_sites() -> frozenset[str]:
@@ -176,6 +177,37 @@ def _check_fleet_endpoints(errors, where: str, c: dict) -> None:
                     0 < int(port) < 65536):
                 _err(errors, where, f"TPUJOB_FLEET_ENDPOINTS entry "
                      f"{entry!r} is not host:port with a valid port")
+
+
+_DRAFT_PRESETS = frozenset({"micro", "tiny"})
+
+
+def _check_spec(errors, where: str, c: dict) -> None:
+    """A manifest carrying speculative-decoding env must carry a COHERENT
+    pair — same offline contract as the fault-plan/tenant checks: a
+    serving worker that dies at startup on a bad --spec-k wastes a
+    scheduled TPU slice. $TPUJOB_DRAFT_MODEL must name a known draft
+    preset (serve/cli.py choices) and $TPUJOB_SPEC_K must be an integer
+    >= 1; each requires the other."""
+    env = {e.get("name"): e for e in c.get("env", [])}
+    draft = env.get("TPUJOB_DRAFT_MODEL")
+    spec_k = env.get("TPUJOB_SPEC_K")
+    if draft is None and spec_k is None:
+        return
+    if (draft is None) != (spec_k is None):
+        _err(errors, where, "TPUJOB_DRAFT_MODEL and TPUJOB_SPEC_K must be "
+             "set together (speculative decoding needs both a draft "
+             "preset and a draft count)")
+    if draft is not None:
+        val = (draft.get("value") or "").strip()
+        if val not in _DRAFT_PRESETS:
+            _err(errors, where, f"TPUJOB_DRAFT_MODEL {val!r} is not a "
+                 f"known draft preset ({sorted(_DRAFT_PRESETS)})")
+    if spec_k is not None:
+        raw = (spec_k.get("value") or "").strip()
+        if not raw.isdigit() or int(raw) < 1:
+            _err(errors, where, f"TPUJOB_SPEC_K {raw!r} must be an "
+                 "integer >= 1")
 
 
 _PRESTOP_SLEEP = re.compile(r"\bsleep\s+(\d+)\b")

@@ -143,6 +143,31 @@ def slot_decode_step(model, params: PyTree, cache: PyTree,
     return logits[:, -1, :], vars_["cache"]
 
 
+def slot_verify_step(model, params: PyTree, cache: PyTree,
+                     tokens: jax.Array, slot_positions: jax.Array,
+                     block_tables: jax.Array | None = None
+                     ) -> tuple[jax.Array, PyTree]:
+    """One speculative VERIFY window: row i's ``tokens[i]`` ([B, W] int32)
+    is written at consecutive per-row positions
+    ``slot_positions[i] + [0, W)`` and each window token attends its own
+    causal prefix (models/transformer.py slot branch, multi-token form —
+    writes land before the gather, so window tokens see each other).
+    Returns ``(logits [B, W, V], cache)``: position ``i`` of the window
+    scores the continuation AFTER ``tokens[:, :i+1]``, which is exactly
+    what the draft-and-verify accept rule compares against. The caller
+    owns the accepted-length cursor arithmetic; rejected window tokens
+    stay in the cache beyond the truncated cursor and are never attended
+    (rollback = cursor truncation, no KV copies)."""
+    kw: dict = {}
+    if block_tables is not None:
+        kw["block_tables"] = block_tables
+    logits, vars_ = model.apply({"params": params, "cache": cache},
+                                tokens, decode=True,
+                                cache_positions=slot_positions,
+                                mutable=["cache"], **kw)
+    return logits, vars_["cache"]
+
+
 def filter_logits(logits: jax.Array, top_k: int | None = None,
                   top_p: float | None = None) -> jax.Array:
     """Top-k / nucleus (top-p) filtering on a [..., V] logits slice: tokens

@@ -31,6 +31,7 @@ import jax.numpy as jnp
 from jax.ad_checkpoint import checkpoint_name
 
 from k8s_distributed_deeplearning_tpu.ops import attention as attention_ops
+from k8s_distributed_deeplearning_tpu.ops import pallas_paged_attn
 
 Dtype = Any
 default_init = nn.initializers.xavier_uniform
@@ -90,9 +91,15 @@ class TransformerConfig:
     rope_theta: float = 500000.0        # Llama-3 default
     tie_embeddings: bool = False
     dtype: Dtype = jnp.bfloat16         # compute dtype; params stay f32
-    attention_impl: str = "auto"        # "auto" | "xla" | "flash" (pallas);
-                                        # auto = measured per-platform/seq-len
-                                        # rule (ops.attention.default_impl)
+    attention_impl: str = "auto"        # "auto" | "xla" | "flash" (pallas)
+                                        # | "paged_flash"; auto = measured
+                                        # per-platform/seq-len rule
+                                        # (ops.attention.default_impl) for
+                                        # training/prefill, and the fused
+                                        # paged decode kernel on TPU for the
+                                        # block-table decode branch.
+                                        # "paged_flash" forces that kernel
+                                        # (interpret-mode off-TPU)
     remat: bool = False                 # checkpoint each block
     remat_policy: str = "dots"          # "dots" (keep matmul outputs —
                                         # measured slightly faster) |
@@ -240,14 +247,20 @@ class Attention(nn.Module):
 
     ``cache_positions`` ([B] int32) selects SLOT decode mode (the
     continuous-batching serving engine, :mod:`serve.engine`): each batch
-    row is an independent request slot with its OWN cursor — the
-    single-token chunk writes at per-row column ``cache_positions[b]``
+    row is an independent request slot with its OWN cursor — token ``i``
+    of the chunk writes at per-row column ``cache_positions[b] + i``
     (a row-indexed scatter instead of the shared-cursor
-    ``dynamic_update_slice``) and attends columns ``<= cache_positions[b]``.
-    Columns beyond a slot's cursor are never read, so a freed slot can be
-    re-filled by a new request's prefill without clearing the stale K/V the
-    previous occupant left behind. The shared scalar ``cache_index`` is
-    untouched: per-slot lengths are the caller's registers.
+    ``dynamic_update_slice``) and attends columns
+    ``<= cache_positions[b] + i``. A [B, 1] chunk is classic one-token
+    decode; a [B, W] chunk is a speculative VERIFY window — W draft
+    tokens written at consecutive per-row positions, each attending its
+    own causal prefix, so one pass scores every draft (serve/engine.py
+    truncates the cursor to the accepted length; stale KV beyond it is
+    never attended, which is what makes rollback free). Columns beyond a
+    slot's cursor are never read, so a freed slot can be re-filled by a
+    new request's prefill without clearing the stale K/V the previous
+    occupant left behind. The shared scalar ``cache_index`` is untouched:
+    per-slot lengths are the caller's registers.
 
     ``block_tables`` ([B, n_blocks] int32) selects PAGED decode mode: the
     cache leaves are one POOL of fixed-size KV pages
@@ -305,11 +318,6 @@ class Attention(nn.Module):
             b, sq = x.shape[0], x.shape[1]
             kv = cfg.resolved_kv_heads
             if cache_positions is not None:
-                if sq != 1:
-                    raise ValueError(
-                        f"slot decode (cache_positions) is strictly "
-                        f"token-at-a-time: got a chunk of {sq} — prefill a "
-                        "slot through the shared-cursor path and splice")
                 if segment_ids is not None:
                     raise NotImplementedError(
                         "slot decode isolates rows by construction (each "
@@ -343,7 +351,8 @@ class Attention(nn.Module):
                             "positions (the chunk's absolute write "
                             "positions); only slot decode can derive "
                             "them from cache_positions")
-                    positions = cache_positions[:, None]
+                    positions = (cache_positions[:, None]
+                                 + jnp.arange(sq, dtype=jnp.int32)[None, :])
             else:
                 # Cache layout [B, S, kv·hd] — heads FOLDED into the lane
                 # dim. The natural [B, S, kv, hd] layout tiles its
@@ -379,7 +388,9 @@ class Attention(nn.Module):
                     # scalar cursor and the seg-validity machinery stay
                     # idle.
                     if positions is None:
-                        positions = cache_positions[:, None]
+                        positions = (cache_positions[:, None]
+                                     + jnp.arange(sq,
+                                                  dtype=jnp.int32)[None, :])
                 else:
                     cur = cache_index.value
                     if use_seg:
@@ -425,30 +436,46 @@ class Attention(nn.Module):
             pool_v = pool_v.at[pg, off].set(
                 v.reshape(b, sq, kv * hd).astype(pool_v.dtype))
             cached_k.value, cached_v.value = pool_k, pool_v
-            s_virt = n_blocks * page_tokens
-            k_all = pool_k[block_tables].reshape(b, s_virt, kv, hd)
-            v_all = pool_v[block_tables].reshape(b, s_virt, kv, hd)
-            col = jnp.arange(s_virt)
-            dmask = (col[None, None, :] <= wpos[:, :, None])[:, None]
-            out = attention_ops.multi_head_attention(
-                q, k_all, v_all, causal=False, mask=dmask, impl="xla")
+            if (cfg.attention_impl == "paged_flash"
+                    or (cfg.attention_impl == "auto"
+                        and pallas_paged_attn.on_tpu())):
+                # Fused gather+attend (ops/pallas_paged_attn.py): the
+                # kernel streams the row's pages straight from the pool
+                # via the scalar-prefetched block table, so the
+                # [B, n_blocks·page_tokens] virtual sequence never
+                # materializes in HBM. Off-TPU "paged_flash" runs the
+                # same kernel in interpret mode (parity tests); "auto"
+                # keeps CPU on the XLA gather below.
+                out = pallas_paged_attn.paged_decode_attention(
+                    q, pool_k, pool_v, block_tables, wpos)
+            else:
+                s_virt = n_blocks * page_tokens
+                k_all = pool_k[block_tables].reshape(b, s_virt, kv, hd)
+                v_all = pool_v[block_tables].reshape(b, s_virt, kv, hd)
+                col = jnp.arange(s_virt)
+                dmask = (col[None, None, :] <= wpos[:, :, None])[:, None]
+                out = attention_ops.multi_head_attention(
+                    q, k_all, v_all, causal=False, mask=dmask, impl="xla")
         elif decode and cache_positions is not None:
-            # Slot decode: the [B, 1] chunk scatters into per-row columns
-            # (each slot's own cursor) and each row attends its prefix
-            # col <= cursor — including the just-written token, so even a
-            # cursor-0 idle slot has one finite score (no NaN softmax).
-            b = x.shape[0]
+            # Slot decode: token i of the [B, sq] chunk scatters into
+            # per-row column cursor+i and attends its prefix
+            # col <= cursor+i — including the just-written token, so even
+            # a cursor-0 idle slot has one finite score (no NaN softmax).
+            # sq == 1 is classic decode; sq > 1 is a speculative verify
+            # window (writes happen before the gather, so window tokens
+            # see each other causally within one pass).
+            b, sq = x.shape[0], x.shape[1]
             kv = cfg.resolved_kv_heads
-            k_all = cached_k.value.at[jnp.arange(b), cache_positions].set(
-                k.reshape(b, kv * hd).astype(cached_k.value.dtype))
-            v_all = cached_v.value.at[jnp.arange(b), cache_positions].set(
-                v.reshape(b, kv * hd).astype(cached_v.value.dtype))
+            wpos = positions.astype(jnp.int32)                    # [B, sq]
+            k_all = cached_k.value.at[jnp.arange(b)[:, None], wpos].set(
+                k.reshape(b, sq, kv * hd).astype(cached_k.value.dtype))
+            v_all = cached_v.value.at[jnp.arange(b)[:, None], wpos].set(
+                v.reshape(b, sq, kv * hd).astype(cached_v.value.dtype))
             cached_k.value, cached_v.value = k_all, v_all
             k_all = k_all.reshape(b, cfg.max_seq_len, kv, hd)
             v_all = v_all.reshape(b, cfg.max_seq_len, kv, hd)
             col = jnp.arange(cfg.max_seq_len)
-            dmask = (col[None, :]
-                     <= cache_positions[:, None])[:, None, None, :]
+            dmask = (col[None, None, :] <= wpos[:, :, None])[:, None]
             out = attention_ops.multi_head_attention(
                 q, k_all, v_all, causal=False, mask=dmask, impl="xla")
         elif decode:

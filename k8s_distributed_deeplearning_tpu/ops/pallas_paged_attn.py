@@ -1,0 +1,215 @@
+"""Pallas TPU paged decode-attention: fused gather+attend over pool pages.
+
+The serving engine's paged decode branch (models/transformer.py) stores KV
+as ONE pool of fixed-size pages ``[num_pages, page_tokens, kv·head_dim]``
+(vLLM's PagedAttention layout, serve/page_pool.py) and, on the XLA path,
+materializes each row's virtual sequence with a
+``pool[block_tables]`` gather before calling plain attention — a
+``[B, n_blocks·page_tokens, kv, hd]`` HBM round-trip per decode step that
+exists only to feed the softmax. This kernel fuses the two: the grid walks
+``(batch, block)``, the block index map reads the SCALAR-PREFETCHED block
+table to pull exactly the page each row's block maps to, and an
+online-softmax (flash-attention style, carried in VMEM scratch across the
+block dimension) attends it in place. Nothing proportional to the virtual
+sequence ever lands in HBM.
+
+Same contract as the XLA path it replaces:
+
+- grouped-query decode attention: q ``[B, sq, H, hd]`` (``sq`` is 1 for
+  classic decode, or a small speculative verify window), KV heads folded
+  into the page lane dim (``kv·hd``), q head ``h`` attends KV head
+  ``h // (H/kv)``;
+- per-row causal cursor masking: query ``i`` of row ``b`` attends virtual
+  columns ``col <= positions[b, i]`` — stale KV beyond a row's cursor
+  (freed-slot garbage, rejected speculative drafts) is never read, and the
+  scratch page (table entries 0) is always masked out by the same rule;
+- blocks wholly past every query's cursor are skipped (``pl.when``), so
+  the work per row is proportional to its LIVE length, not the table
+  width.
+
+Off-TPU the kernel runs in the Pallas interpreter (``interpret`` defaults
+to ``not on_tpu()``), so CPU CI exercises the exact same code path —
+tier-1 keeps the XLA gather as its default via the ``attention_impl``
+selection in models/transformer.py and opts into the kernel explicitly
+(``"paged_flash"``) for parity tests.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform in ("tpu", "axon")
+    except Exception:
+        return False
+
+
+def _compiler_params(interpret):
+    # batch is embarrassingly parallel; the block dim carries the
+    # online-softmax scratch, so it stays sequential. jax<0.5 names the
+    # params class TPUCompilerParams; only reached on real TPU.
+    if interpret:
+        return None
+    params_cls = getattr(pltpu, "CompilerParams",
+                         getattr(pltpu, "TPUCompilerParams", None))
+    return params_cls(dimension_semantics=("parallel", "arbitrary"))
+
+
+def _kernel(tables_ref, q_ref, k_ref, v_ref, pos_ref, o_ref,
+            m_s, l_s, acc_s, *, hkv, group, hd, page_tokens, scale):
+    """One (batch row, virtual block) grid cell.
+
+    ``tables_ref`` is the scalar-prefetched block table — consumed by the
+    K/V index maps (which page this cell reads), unused in the body.
+    Scratch ``m_s``/``l_s`` are [H, sq] f32 and ``acc_s`` is [H, sq, hd]
+    f32, carried across the (sequential) block dimension. Head loops are
+    python-static: each (kv head, group member) pair is a static lane
+    slice of the folded refs — the pallas_flash per-head idiom, one level
+    up.
+    """
+    j = pl.program_id(1)
+    n_blocks = pl.num_programs(1)
+    sq = q_ref.shape[1]
+    h_all = hkv * group
+
+    @pl.when(j == 0)
+    def _init():
+        m_s[...] = jnp.full_like(m_s, NEG_INF)
+        l_s[...] = jnp.zeros_like(l_s)
+        acc_s[...] = jnp.zeros_like(acc_s)
+
+    pos = pos_ref[0, 0, :]                                     # [sq] int32
+    # Skip blocks wholly beyond every query's cursor: the first virtual
+    # column of block j is j·page_tokens; nothing in a later block can be
+    # attended by any row of this batch element.
+    @pl.when(j * page_tokens <= jnp.max(pos))
+    def _block():
+        col = (j * page_tokens
+               + jax.lax.broadcasted_iota(jnp.int32, (sq, page_tokens), 1))
+        allow = col <= pos[:, None]                            # [sq, bt]
+        for h in range(hkv):
+            k_h = k_ref[0, :, h * hd:(h + 1) * hd]             # [bt, hd]
+            v_h = v_ref[0, :, h * hd:(h + 1) * hd]
+            for t in range(group):
+                qi = h * group + t
+                q_t = q_ref[0, :, qi * hd:(qi + 1) * hd]       # [sq, hd]
+                s = jax.lax.dot_general(
+                    q_t, k_h, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32) * scale
+                s = jnp.where(allow, s, NEG_INF)
+                m_prev = m_s[qi, :]
+                m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
+                p = jnp.exp(s - m_new[:, None])
+                # Fully-masked guard: a row whose cursor sits before this
+                # block contributes exactly zero (not exp(0) rows).
+                p = jnp.where(s <= NEG_INF / 2, 0.0, p)
+                alpha = jnp.exp(m_prev - m_new)
+                pv = jax.lax.dot_general(
+                    p.astype(v_h.dtype), v_h, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)        # [sq, hd]
+                acc_s[qi] = acc_s[qi] * alpha[:, None] + pv
+                l_s[qi, :] = alpha * l_s[qi, :] + jnp.sum(p, axis=1)
+                m_s[qi, :] = m_new
+
+    @pl.when(j == n_blocks - 1)
+    def _emit():
+        for qi in range(h_all):
+            norm = jnp.maximum(l_s[qi, :], 1e-30)
+            o_ref[0, :, qi * hd:(qi + 1) * hd] = (
+                acc_s[qi] / norm[:, None]).astype(o_ref.dtype)
+
+
+def paged_decode_attention(q: jax.Array, pool_k: jax.Array,
+                           pool_v: jax.Array, block_tables: jax.Array,
+                           positions: jax.Array, *,
+                           softmax_scale: float | None = None,
+                           interpret: bool | None = None) -> jax.Array:
+    """Grouped-query decode attention straight off the page pool.
+
+    q: ``[B, sq, H, hd]`` (``sq`` = 1 for classic decode or the
+    speculative verify-window width); pool_k/pool_v:
+    ``[num_pages, page_tokens, kv·hd]`` (the engine's folded-head page
+    layout — written BEFORE this is called, so window tokens see each
+    other); block_tables: ``[B, n_blocks]`` int32 mapping each row's
+    virtual blocks onto pool pages (0 = the never-attended scratch page);
+    positions: ``[B, sq]`` int32 absolute cursor per query token — row
+    ``b`` query ``i`` attends virtual columns ``<= positions[b, i]``.
+    Returns ``[B, sq, H, hd]`` in q's dtype. ``interpret=None`` picks the
+    real kernel on TPU and the Pallas interpreter elsewhere.
+    """
+    if q.ndim != 4:
+        raise ValueError(f"q must be [B, sq, H, hd], got {q.shape}")
+    if pool_k.ndim != 3 or pool_k.shape != pool_v.shape:
+        raise ValueError(
+            f"pool_k/pool_v must be identical [num_pages, page_tokens, "
+            f"kv*hd], got {pool_k.shape} / {pool_v.shape}")
+    b, sq, h, hd = q.shape
+    _, page_tokens, kvhd = pool_k.shape
+    if kvhd % hd:
+        raise ValueError(
+            f"pool lane dim {kvhd} is not a multiple of head_dim {hd}")
+    hkv = kvhd // hd
+    if h % hkv:
+        raise ValueError(
+            f"{h} q heads not divisible by {hkv} kv heads")
+    if block_tables.ndim != 2 or block_tables.shape[0] != b:
+        raise ValueError(
+            f"block_tables must be [B={b}, n_blocks], "
+            f"got {block_tables.shape}")
+    if positions.shape != (b, sq):
+        raise ValueError(
+            f"positions must be [B={b}, sq={sq}], got {positions.shape}")
+    if interpret is None:
+        interpret = not on_tpu()
+    group = h // hkv
+    n_blocks = block_tables.shape[1]
+    scale = softmax_scale if softmax_scale is not None else hd ** -0.5
+
+    qf = q.reshape(b, sq, h * hd)
+    # [B, 1, sq]: the length-1 middle dim keeps the last-two-dims tiling
+    # legal for any B (same trick as pallas_flash's segment/lse specs).
+    pos3 = positions.astype(jnp.int32)[:, None, :]
+    tables = block_tables.astype(jnp.int32)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b, n_blocks),
+        in_specs=[
+            pl.BlockSpec((1, sq, h * hd), lambda i, j, tbl: (i, 0, 0)),
+            pl.BlockSpec((1, page_tokens, kvhd),
+                         lambda i, j, tbl: (tbl[i, j], 0, 0)),
+            pl.BlockSpec((1, page_tokens, kvhd),
+                         lambda i, j, tbl: (tbl[i, j], 0, 0)),
+            pl.BlockSpec((1, 1, sq), lambda i, j, tbl: (i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, sq, h * hd), lambda i, j, tbl: (i, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((h, sq), jnp.float32),
+            pltpu.VMEM((h, sq), jnp.float32),
+            pltpu.VMEM((h, sq, hd), jnp.float32),
+        ],
+    )
+    s_virt = n_blocks * page_tokens
+    kernel = functools.partial(_kernel, hkv=hkv, group=group, hd=hd,
+                               page_tokens=page_tokens, scale=scale)
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, sq, h * hd), q.dtype),
+        compiler_params=_compiler_params(interpret),
+        cost_estimate=pl.CostEstimate(
+            flops=4 * b * h * sq * s_virt * hd,
+            bytes_accessed=(qf.size * qf.dtype.itemsize
+                            + 2 * b * s_virt * kvhd * pool_k.dtype.itemsize),
+            transcendentals=b * h * sq * s_virt),
+        interpret=interpret,
+    )(tables, qf, pool_k, pool_v, pos3)
+    return out.reshape(b, sq, h, hd)

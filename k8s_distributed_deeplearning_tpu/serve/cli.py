@@ -74,6 +74,17 @@ def main(argv: list[str] | None = None) -> int:
                          "plans). Workload requests are assigned round-"
                          "robin across the configured tenants; omitted = "
                          "single unlimited default tenant (FCFS)")
+    ap.add_argument("--draft-model", choices=["micro", "tiny"], default=None,
+                    help="enable speculative decoding with this draft "
+                         "preset (micro: 1-layer width-32; tiny: the test "
+                         "config) — built with the TARGET's vocab, "
+                         "max-seq-len and dtype so proposals are target "
+                         "token ids; requires --spec-k")
+    ap.add_argument("--spec-k", type=int, default=0,
+                    help="draft tokens proposed per slot per iteration "
+                         "(>= 1; requires --draft-model). Each iteration "
+                         "then emits 1..k+1 tokens per slot, bit-identical "
+                         "to non-speculative decoding")
     ap.add_argument("--temperature", type=float, default=0.0)
     ap.add_argument("--top-k", type=int, default=0)
     ap.add_argument("--top-p", type=float, default=1.0)
@@ -134,6 +145,12 @@ def main(argv: list[str] | None = None) -> int:
                  "duplicates a dispatch onto a PEER replica)")
     if args.hedge_after_s is not None and args.hedge_after_s <= 0:
         ap.error(f"--hedge-after-s must be > 0, got {args.hedge_after_s}")
+    if (args.draft_model is None) != (args.spec_k == 0):
+        ap.error("speculative decoding needs BOTH --draft-model and "
+                 f"--spec-k >= 1 (got --draft-model {args.draft_model}, "
+                 f"--spec-k {args.spec_k})")
+    if args.spec_k < 0:
+        ap.error(f"--spec-k must be >= 1 (0 = off), got {args.spec_k}")
 
     import signal
 
@@ -169,6 +186,25 @@ def main(argv: list[str] | None = None) -> int:
     params = model.init(jax.random.PRNGKey(args.seed),
                         jnp.zeros((1, 8), jnp.int32))["params"]
 
+    draft_model = draft_params = None
+    if args.draft_model is not None:
+        # Draft presets are depth/width recipes stamped with the TARGET's
+        # vocab, max_seq_len and dtype (the engine requires both models to
+        # speak the same token ids over the same positions).
+        if args.draft_model == "micro":
+            dcfg = llama.config_tiny(
+                vocab_size=cfg.vocab_size, dim=32, n_layers=1, n_heads=2,
+                n_kv_heads=1, mlp_dim=64, max_seq_len=cfg.max_seq_len,
+                dtype=cfg.dtype)
+        else:
+            dcfg = llama.config_tiny(
+                vocab_size=cfg.vocab_size, max_seq_len=cfg.max_seq_len,
+                dtype=cfg.dtype)
+        draft_model = llama.LlamaLM(dcfg)
+        draft_params = draft_model.init(
+            jax.random.PRNGKey(args.seed + 1),
+            jnp.zeros((1, 8), jnp.int32))["params"]
+
     p_lo, p_hi = args.prompt_len
     o_lo, o_hi = args.out_len
     if args.shared_prefix_len + p_hi + o_hi > cfg.max_seq_len:
@@ -202,6 +238,8 @@ def main(argv: list[str] | None = None) -> int:
             kv_pool_pages=args.kv_pool_pages or None,
             request_trace_sample=args.request_trace_sample,
             request_log=logger, stats=stats,
+            draft_model=draft_model, draft_params=draft_params,
+            spec_k=args.spec_k,
             replica_id=f"r{i}" if args.replicas > 1 else None)
         for i in range(args.replicas)]
     engine = engines[0]
@@ -298,6 +336,15 @@ def main(argv: list[str] | None = None) -> int:
     logger.emit("serve_summary", num_slots=args.slots,
                 preset=args.preset, replicas=args.replicas,
                 **stats.summary())
+    if args.spec_k:
+        summ = stats.summary()
+        logger.emit("spec_summary", draft=args.draft_model,
+                    spec_k=args.spec_k,
+                    spec_steps=summ["spec_steps"],
+                    spec_proposed_tokens=summ["spec_proposed_tokens"],
+                    spec_accepted_tokens=summ["spec_accepted_tokens"],
+                    spec_acceptance_rate=summ["spec_acceptance_rate"],
+                    spec_accept_hist=summ["spec_accept_hist"])
     if tenant_cfgs is not None:
         for e in engines:
             snap = e.queue.snapshot()

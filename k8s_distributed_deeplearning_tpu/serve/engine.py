@@ -151,6 +151,72 @@ def _decode_program(model, params: PyTree, cache: PyTree, tokens: jax.Array,
     return nxt, keys, cache
 
 
+@functools.partial(jax.jit, static_argnames=("model", "steps"),
+                   donate_argnames=("cache",))
+def _spec_draft_program(model, params: PyTree, cache: PyTree,
+                        tokens: jax.Array, kv_lens: jax.Array,
+                        tables: jax.Array, *, steps: int):
+    """Draft half of a speculative iteration: ``steps`` greedy
+    single-token slot decodes through the DRAFT model's paged cache,
+    scanned into ONE dispatch. Returns ``(window [B, steps], cache)``:
+    column 0 is the input token (each slot's last emitted one) and
+    columns 1.. are the draft proposals — exactly the verify window the
+    target pass scores. The final scan iteration writes the last draft's
+    KV (its logits are discarded), so a fully-accepted window leaves the
+    draft cache gap-free at the advanced cursor. Free slots ride along
+    inert exactly as in :func:`_decode_program`."""
+
+    def body(carry, _):
+        cache, tok, pos = carry
+        logits, cache = generate.slot_decode_step(model, params, cache,
+                                                  tok, pos,
+                                                  block_tables=tables)
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return (cache, nxt, pos + 1), tok
+
+    (cache, _, _), fed = jax.lax.scan(body, (cache, tokens, kv_lens),
+                                      None, length=steps)
+    return fed.T, cache
+
+
+@functools.partial(jax.jit, static_argnames=("model",),
+                   donate_argnames=("cache",))
+def _spec_verify_program(model, params: PyTree, cache: PyTree,
+                         window: jax.Array, kv_lens: jax.Array,
+                         tables: jax.Array, temps: jax.Array,
+                         top_ks: jax.Array, top_ps: jax.Array,
+                         keys: jax.Array):
+    """Verify half: ONE multi-token target pass over the [B, W] draft
+    window (written at per-row positions ``kv_lens + [0, W)`` — rollback
+    is the caller truncating its cursor, no KV copies), then a chained
+    selection per window position with the SAME per-slot sampling rule as
+    :func:`_decode_program`. The key chain splits once per position in
+    order, and ``key_states[:, i]`` is the register value after ``i + 1``
+    splits — the host sets each slot's key to the state after its actual
+    emitted count, so the PRNG stream is bit-identical to non-speculative
+    decoding for every sampling config (greedy rows compare argmax;
+    sampled rows compare the target's own chained sample — exact-match
+    accept). Returns ``(sel [B, W], key_states [B, W, 2],
+    accepted [B], cache)`` where ``accepted`` is the per-row count of
+    leading drafts matching the target's selections."""
+    logits, cache = generate.slot_verify_step(model, params, cache,
+                                              window, kv_lens,
+                                              block_tables=tables)
+
+    def body(keys, row_logits):
+        new_keys, toks = _sample_slots(row_logits, temps, top_ks, top_ps,
+                                       keys)
+        return new_keys, (toks, new_keys)
+
+    _, (sel, key_states) = jax.lax.scan(body, keys,
+                                        jnp.moveaxis(logits, 1, 0))
+    sel = sel.T                                            # [B, W]
+    key_states = jnp.moveaxis(key_states, 1, 0)            # [B, W, 2]
+    matches = (window[:, 1:] == sel[:, :-1]).astype(jnp.int32)
+    accepted = jnp.sum(jnp.cumprod(matches, axis=1), axis=1)
+    return sel, key_states, accepted, cache
+
+
 def _leaf_name(path) -> str | None:
     """Name of a cache leaf from its tree path (DictKey at the tail for
     both unrolled and layer-scanned layouts)."""
@@ -199,7 +265,8 @@ class _InFlight:
     """Host-side record for the request occupying a slot."""
 
     __slots__ = ("req", "tokens", "t_submit", "t_admit", "t_first",
-                 "cached_prompt_tokens", "prefill_chunks", "grow_left")
+                 "cached_prompt_tokens", "prefill_chunks", "grow_left",
+                 "spec_proposed", "spec_accepted")
 
     def __init__(self, req: Request, first_token: int, t_admit: float):
         self.req = req
@@ -210,6 +277,8 @@ class _InFlight:
         self.cached_prompt_tokens = 0
         self.prefill_chunks = 0
         self.grow_left = 0       # reserved-but-unallocated decode pages
+        self.spec_proposed = 0   # draft tokens proposed for this request
+        self.spec_accepted = 0   # draft tokens accepted AND emitted
 
     def __repr__(self):
         return (f"_InFlight({self.req.request_id}, "
@@ -225,10 +294,11 @@ class _PendingPrefill:
     ``grow`` is the slot's reserved decode-growth page count."""
 
     __slots__ = ("req", "prompt", "n", "pos", "hit_tokens", "nodes",
-                 "t_pop", "chunks", "grow")
+                 "t_pop", "chunks", "grow", "table")
 
     def __init__(self, req: Request, prompt: np.ndarray, pos: int,
-                 hit_tokens: int, nodes: list, t_pop: float, grow: int):
+                 hit_tokens: int, nodes: list, t_pop: float, grow: int,
+                 table: np.ndarray):
         self.req = req
         self.prompt = prompt
         self.n = int(prompt.shape[0])
@@ -238,6 +308,12 @@ class _PendingPrefill:
         self.t_pop = t_pop
         self.chunks = 0        # compiled prefill program runs so far
         self.grow = grow
+        self.table = table     # PRIVATE block-table row until admission:
+        # the engine-wide table must keep this slot all-scratch while the
+        # prefill is pending, because the decode program writes a rider
+        # KV row for EVERY slot at its (stale, pre-admission) cursor — a
+        # half-built table there would take that garbage write into the
+        # request's freshly prefilled prompt pages.
 
 
 class ServeEngine:
@@ -272,6 +348,19 @@ class ServeEngine:
     ``prefix_block_tokens`` sets the pool's page size (default
     ``min_bucket``) — trie block and pool page are ONE granularity.
 
+    ``draft_model``/``draft_params``/``spec_k`` (all or none) turn on
+    speculative decoding (Leviathan et al.): each iteration, the draft
+    model proposes ``spec_k`` greedy tokens per slot through its OWN
+    paged cache (same page indices/tables as the target's — one pool,
+    two KV arrays — so trie-shared prompt pages carry valid draft KV
+    too), and ONE multi-token target pass verifies the window with
+    exact-match accept. Output is bit-identical to non-speculative
+    decoding for every sampling config; rollback of rejected drafts is
+    pure cursor truncation on the paged pool — stale KV beyond the
+    cursor is never attended and is overwritten in place by the next
+    window before it is read. The draft model must share the target's
+    vocabulary and cover its ``max_seq_len``.
+
     ``tenants`` (optional) configures the SLO-aware multi-tenant
     scheduler (serve/sched): per-tenant EDF queues drained by
     deficit-weighted round-robin under strict priority classes, with
@@ -293,7 +382,9 @@ class ServeEngine:
                  tracer: Tracer | None = None,
                  request_trace_sample: float = 0.0,
                  request_log: "Any | None" = None,
-                 replica_id: str | None = None):
+                 replica_id: str | None = None,
+                 draft_model=None, draft_params: PyTree | None = None,
+                 spec_k: int = 0):
         if num_slots < 2:
             raise ValueError(f"num_slots must be >= 2, got {num_slots}")
         cfg = getattr(model, "cfg", None)
@@ -317,6 +408,29 @@ class ServeEngine:
             raise ValueError(
                 f"request_trace_sample must be in [0, 1], got "
                 f"{request_trace_sample}")
+        if (draft_model is None) != (spec_k == 0):
+            raise ValueError(
+                "speculative decoding needs BOTH a draft model and "
+                f"spec_k >= 1 (got draft_model={draft_model!r}, "
+                f"spec_k={spec_k})")
+        if draft_model is not None:
+            if draft_params is None:
+                raise ValueError("draft_model set but draft_params is None")
+            if spec_k < 1:
+                raise ValueError(f"spec_k must be >= 1, got {spec_k}")
+            dcfg = getattr(draft_model, "cfg", None)
+            dv = getattr(dcfg, "vocab_size", None)
+            tv = getattr(cfg, "vocab_size", None)
+            if dv != tv:
+                raise ValueError(
+                    f"draft vocab_size ({dv}) != target vocab_size ({tv}) "
+                    "— draft proposals must be target token ids")
+            dmax = getattr(dcfg, "max_seq_len", 0)
+            if dmax < max_seq:
+                raise ValueError(
+                    f"draft max_seq_len ({dmax}) < target max_seq_len "
+                    f"({max_seq}) — the draft cache shares the target's "
+                    "block tables and must cover every position")
         self.model = model
         self.params = params
         self.num_slots = num_slots
@@ -382,7 +496,20 @@ class ServeEngine:
         _, self._row_shapes = jax.eval_shape(
             lambda p, t: generate.prefill(self.model, p, t),
             self.params, dummy)
-        self._cache = self._init_pool_cache()
+        self._cache = self._init_pool_cache(self._row_shapes)
+        # Speculative decoding: the draft cache is a SECOND paged KV
+        # arena over the SAME page indices — block tables, the trie and
+        # the refcounts are shared, only the arrays (sized for the draft
+        # model) are separate. Every prefill/decode write lands in both.
+        self.draft_model = draft_model
+        self.draft_params = draft_params
+        self.spec_k = int(spec_k)
+        self._draft_cache: PyTree | None = None
+        if self.spec_k:
+            _, draft_shapes = jax.eval_shape(
+                lambda p, t: generate.prefill(self.draft_model, p, t),
+                self.draft_params, dummy)
+            self._draft_cache = self._init_pool_cache(draft_shapes)
         self.prefix_cache: PrefixCache | None = None
         if prefix_cache_mb is not None and prefix_cache_mb > 0:
             self.prefix_cache = PrefixCache(
@@ -395,13 +522,15 @@ class ServeEngine:
         self._step_prefill_budget: int | None = None
         self._record_pool_gauges()
 
-    def _init_pool_cache(self) -> PyTree:
+    def _init_pool_cache(self, row_shapes: PyTree) -> PyTree:
         """Zero-filled page pool with the cache-leaf structure a prefill
-        produces, keeping ONLY cached_key/cached_value (the paged decode
-        branch declares nothing else) and reshaping each leaf's
-        [..., 1, max_seq, F] row layout to [..., num_pages, page_tokens, F].
-        KV content is irrelevant — nothing is attended until a table maps
-        a written page."""
+        produces (``row_shapes``: the target model's single-row
+        eval_shape, or the draft model's for its sibling arena), keeping
+        ONLY cached_key/cached_value (the paged decode branch declares
+        nothing else) and reshaping each leaf's [..., 1, max_seq, F] row
+        layout to [..., num_pages, page_tokens, F]. KV content is
+        irrelevant — nothing is attended until a table maps a written
+        page."""
         bt, pages = self.page_tokens, self.pool.num_pages
 
         def build(tree):
@@ -418,7 +547,7 @@ class ServeEngine:
                     out[name] = jnp.zeros(shape, v.dtype)
             return out
 
-        return build(self._row_shapes)
+        return build(row_shapes)
 
     def _block_nbytes(self, block_tokens: int) -> int:
         """Bytes of KV one pool page holds (seq dim of every cached_key/
@@ -606,20 +735,34 @@ class ServeEngine:
         if active == 0:
             self._record_pool_gauges()
             return outputs
-        # Decode-growth pages: a slot whose next write position crosses
-        # into an unmapped block claims one of ITS reserved pages —
+        # Decode-growth pages: a slot whose next write positions cross
+        # into unmapped blocks claims from ITS reserved pages —
         # infallible by construction (reserved at admission), so growth
-        # can never be starved by other admissions.
+        # can never be starved by other admissions. A speculative step
+        # writes up to spec_k positions past the cursor, but never past
+        # the request's own budget (position n + max_new - 2 is the last
+        # one any emitted token can occupy) — writes beyond that land in
+        # the scratch page and the garbage selections they feed are
+        # provably never emitted.
         for slot, fl in enumerate(self._slots):
             if fl is None:
                 continue
-            blk = int(self._kv_lens[slot]) // self.page_tokens
-            if self._tables[slot, blk] == 0:
-                self._tables[slot, blk] = self.pool.alloc_reserved(1)[0]
-                fl.grow_left -= 1
+            last = int(self._kv_lens[slot])
+            if self.spec_k:
+                limit = len(fl.req.prompt) + fl.req.max_new_tokens - 2
+                last = min(last + self.spec_k, limit)
+            for blk in range(int(self._kv_lens[slot]) // self.page_tokens,
+                             last // self.page_tokens + 1):
+                if self._tables[slot, blk] == 0:
+                    self._tables[slot, blk] = self.pool.alloc_reserved(1)[0]
+                    fl.grow_left -= 1
         inj = _faults.active()
         if inj is not None:
             inj.fire("serve_decode")
+        if self.spec_k:
+            self._spec_decode(active, outputs)
+            self._record_pool_gauges()
+            return outputs
         with self.tracer.span("decode", active=active):
             nxt, keys, self._cache = _decode_program(
                 self.model, self.params, self._cache, self._tokens,
@@ -651,6 +794,86 @@ class ServeEngine:
                 outputs.append(self._finish(slot, "length"))
         self._record_pool_gauges()
         return outputs
+
+    # graftlint: hot-path
+    def _spec_decode(self, active: int,
+                     outputs: list[RequestOutput]) -> None:
+        """One speculative serving iteration: ``spec_k`` greedy draft
+        proposals per slot (scanned into one dispatch over the draft
+        model's sibling paged cache), ONE multi-token verify pass through
+        the target model, then host-side accept bookkeeping. Each slot
+        emits the longest prefix of drafts matching the target's own
+        selections plus the target's correction/bonus token (1 to
+        spec_k + 1 tokens) — bit-identical to non-speculative decoding
+        for every sampling config, because the accept rule is exact
+        match against the target selection drawn with the slot's chained
+        key (see :func:`_spec_verify_program`). Rollback is cursor
+        truncation: rejected drafts stay in pages beyond the advanced
+        cursor, never attended, overwritten in place by the next window
+        before anything reads them."""
+        w = self.spec_k + 1
+        with self.tracer.span("decode", active=active, spec_k=self.spec_k):
+            window, self._draft_cache = _spec_draft_program(
+                self.draft_model, self.draft_params, self._draft_cache,
+                self._tokens, self._kv_lens, self._tables, steps=w)
+            sel, key_states, acc, self._cache = _spec_verify_program(
+                self.model, self.params, self._cache, window,
+                self._kv_lens, self._tables, self._temps, self._top_ks,
+                self._top_ps, self._keys)
+            # graftlint: disable=host-sync — the iteration's one honest
+            # sync: every slot's window/selections in a single fence.
+            window = np.asarray(window)
+            # graftlint: disable=host-sync — rides the same fence
+            sel = np.asarray(sel)
+            # graftlint: disable=host-sync — rides the same fence
+            acc = np.asarray(acc)
+            # np.array (copy): the key register is written in place at
+            # admissions, and only the emitted-count column survives.
+            # graftlint: disable=host-sync — rides the same fence
+            key_states = np.array(key_states)
+        emitted_total = 0
+        proposed = 0
+        accepted_counts: list[int] = []
+        for slot, fl in enumerate(self._slots):
+            if fl is None:
+                continue
+            a = int(acc[slot])
+            # Candidates in emission order: the accepted drafts, then the
+            # target's correction (a < k) or bonus (a == k) token.
+            cand = [int(window[slot, i]) for i in range(1, a + 1)]
+            cand.append(int(sel[slot, a]))
+            proposed += self.spec_k
+            m = 0
+            finished = None
+            for tok in cand:
+                m += 1
+                fl.tokens.append(tok)
+                if fl.req.on_token is not None:
+                    fl.req.on_token(tok)
+                if self.eos_id is not None and tok == self.eos_id:
+                    finished = "eos"
+                    break
+                if len(fl.tokens) >= fl.req.max_new_tokens:
+                    finished = "length"
+                    break
+            # Drafts among the emitted tokens (the final candidate is the
+            # target's own selection, not a draft).
+            acc_emitted = min(m, a)
+            accepted_counts.append(acc_emitted)
+            fl.spec_proposed += self.spec_k
+            fl.spec_accepted += acc_emitted
+            emitted_total += m
+            # Cursor advance IS the accept/rollback: the m emitted
+            # tokens' KV (all written this step) become live; everything
+            # beyond kv_lens + m is dead by the col <= cursor mask.
+            self._kv_lens[slot] += m
+            self._tokens[slot] = cand[m - 1]
+            self._keys[slot] = key_states[slot, m - 1]
+            if finished is not None:
+                outputs.append(self._finish(slot, finished))
+        self.stats.record_step(active, self.num_slots,
+                               tokens=emitted_total)
+        self.stats.record_spec_step(proposed, accepted_counts)
 
     def run(self, requests: Iterable[Request] | None = None,
             max_steps: int | None = None) -> list[RequestOutput]:
@@ -725,6 +948,14 @@ class ServeEngine:
         """Compiled-program count of the intermediate-chunk step (≤ one
         per distinct chunk width)."""
         return _chunk_program._cache_size()
+
+    @staticmethod
+    def spec_cache_size() -> int:
+        """Compiled-program count of the speculative draft + verify pair
+        (one entry each per (model, spec_k) — the compiles-once check for
+        the speculative path)."""
+        return (_spec_draft_program._cache_size()
+                + _spec_verify_program._cache_size())
 
     # ----------------------------------------------------------- internals
 
@@ -803,6 +1034,8 @@ class ServeEngine:
             decode_steps=max(0, n - 1),
             tokens_per_s=(round(n / out.latency_s, 1)
                           if n and out.latency_s > 0 else None),
+            spec_proposed=out.spec_proposed,
+            spec_accepted=out.spec_accepted,
             finish_reason=out.finish_reason)
         self.stats.record_request_trace()
 
@@ -847,10 +1080,14 @@ class ServeEngine:
 
     def _begin_admission(self, slot: int, req: Request) -> None:
         """Reserve *slot* for *req*: map the longest trie-cached prefix
-        into its block table (ZERO device copies — each matched node's
-        page is ref'd and written into the table), allocate private pages
-        for the uncached prompt tail, reserve worst-case decode growth,
-        and park it as a pending prefill for :meth:`_run_prefills`.
+        into a PRIVATE block-table row (ZERO device copies — each matched
+        node's page is ref'd and written into the row), allocate private
+        pages for the uncached prompt tail, reserve worst-case decode
+        growth, and park it as a pending prefill for :meth:`_run_prefills`.
+        The row is installed engine-wide only at :meth:`_finish_admission`
+        — until then the slot stays all-scratch in ``self._tables`` so the
+        decode program's rider write for this (stale-cursor) slot lands in
+        the scratch page, not in the half-prefilled prompt.
         Allocation cannot fail here: the scheduler's ``fits`` probe
         guaranteed the (hit-blind, hence conservative) need before the
         pop, and nothing else allocates in between."""
@@ -859,21 +1096,22 @@ class ServeEngine:
         prompt = np.asarray(req.prompt, np.int32)
         bt = self.page_tokens
         hit, nodes = 0, []
+        table = np.zeros(self.max_blocks, np.int32)
         with self.tracer.span("admission", prompt_len=n, slot=slot):
             if self.prefix_cache is not None:
                 hit, nodes = self.prefix_cache.acquire(prompt.tolist())
                 self.stats.record_prefix_lookup(hit, n)
                 for j, node in enumerate(nodes):
                     self.pool.ref(node.page)
-                    self._tables[slot, j] = node.page
+                    table[j] = node.page
             n_prompt_blocks = -(-n // bt)
             priv = self.pool.alloc(n_prompt_blocks - hit // bt)
-            self._tables[slot, hit // bt:n_prompt_blocks] = priv
+            table[hit // bt:n_prompt_blocks] = priv
             grow = (-(-(n + req.max_new_tokens - 1) // bt)
                     - n_prompt_blocks)
             self.pool.reserve(grow)
         self._pending[slot] = _PendingPrefill(req, prompt, hit, hit, nodes,
-                                              t_pop, grow)
+                                              t_pop, grow, table)
         t0 = req._t_submit if req._t_submit is not None else t_pop
         self.stats.record_admission(queue_s=t_pop - t0, prompt_len=n)
 
@@ -888,7 +1126,7 @@ class ServeEngine:
         for slot in list(self._pending):
             pend = self._pending.get(slot)
             c = self.prefill_chunk_tokens
-            table = self._tables[slot:slot + 1]
+            table = pend.table[None, :]
             while pend is not None:
                 rem = pend.n - pend.pos
                 budget = self._step_prefill_budget
@@ -902,6 +1140,13 @@ class ServeEngine:
                             np.ascontiguousarray(chunk),
                             np.ascontiguousarray(table),
                             np.int32(pend.pos))
+                        if self.spec_k:
+                            self._draft_cache = _chunk_program(
+                                self.draft_model, self.draft_params,
+                                self._draft_cache,
+                                np.ascontiguousarray(chunk),
+                                np.ascontiguousarray(table),
+                                np.int32(pend.pos))
                     pend.pos += c
                     pend.chunks += 1
                     self._charge_prefill(c)
@@ -939,6 +1184,10 @@ class ServeEngine:
         sp = req.sampling
         chunk = np.full((1, bucket), self.pad_id, np.int32)
         chunk[0, :rem] = pend.prompt[pend.pos:]
+        # Admission completes this step: install the pending row engine-
+        # wide. The slot's cursor is set to n below, BEFORE the next
+        # decode, so the rider write lands past the prompt from now on.
+        self._tables[slot, :] = pend.table
         table = self._tables[slot:slot + 1]
         with self.tracer.span("prefill", bucket=bucket, slot=slot,
                               cached=pend.hit_tokens):
@@ -948,6 +1197,15 @@ class ServeEngine:
                 np.int32(rem), np.float32(sp.temperature),
                 np.int32(sp.top_k), np.float32(sp.top_p),
                 np.asarray(jax.random.PRNGKey(req.seed), np.uint32))
+            if self.spec_k:
+                # Mirror the final chunk into the draft arena (logits
+                # DCE'd): same padded chunk, same table, same positions
+                # — pad writes land beyond the cursor or in scratch,
+                # exactly as on the target path.
+                self._draft_cache = _chunk_program(
+                    self.draft_model, self.draft_params,
+                    self._draft_cache, chunk,
+                    np.ascontiguousarray(table), np.int32(pend.pos))
             if self.prefix_cache is not None:
                 # Adopt whole prompt blocks into the trie by REFERENCE:
                 # the trie takes its own refcount on the slot's page, so
@@ -988,15 +1246,20 @@ class ServeEngine:
             return self._finish(slot, "length")
         return None
 
-    def _release_slot_pages(self, slot: int, grow_left: int) -> None:
+    def _release_slot_pages(self, slot: int, grow_left: int,
+                            row: np.ndarray | None = None) -> None:
         """Terminal page bookkeeping: deref every mapped page (freeing
         those the trie doesn't also hold), reset the table row to
-        all-scratch, and return unused growth reservation."""
+        all-scratch, and return unused growth reservation. *row* is the
+        still-private pending row for a request cancelled mid-prefill
+        (its pages were never installed into ``self._tables``)."""
+        if row is None:
+            row = self._tables[slot]
         for j in range(self.max_blocks):
-            page = int(self._tables[slot, j])
+            page = int(row[j])
             if page:
                 self.pool.deref(page)
-        self._tables[slot, :] = 0
+        row[:] = 0
         if grow_left:
             self.pool.unreserve(grow_left)
 
@@ -1008,7 +1271,7 @@ class ServeEngine:
         if self.prefix_cache is not None and pend.nodes:
             self.prefix_cache.release(pend.nodes)
             pend.nodes = []
-        self._release_slot_pages(slot, pend.grow)
+        self._release_slot_pages(slot, pend.grow, row=pend.table)
         now = time.perf_counter()
         t0 = (pend.req._t_submit if pend.req._t_submit is not None else now)
         out = RequestOutput(
@@ -1034,7 +1297,9 @@ class ServeEngine:
             ttft_s=fl.t_first - fl.t_submit,
             latency_s=now - fl.t_submit,
             cached_prompt_tokens=fl.cached_prompt_tokens,
-            prefill_chunks=fl.prefill_chunks)
+            prefill_chunks=fl.prefill_chunks,
+            spec_proposed=fl.spec_proposed,
+            spec_accepted=fl.spec_accepted)
         self._slots[slot] = None
         self._tokens[slot] = self.pad_id
         self._kv_lens[slot] = 0

@@ -140,7 +140,10 @@ class RequestOutput:
     prefilled (0 when the cache is off or missed). ``prefill_chunks``
     counts compiled prefill program runs spent on this request's prompt
     (intermediate chunks + the final sampling chunk; 0 for requests that
-    never started prefilling).
+    never started prefilling). ``spec_proposed``/``spec_accepted`` count
+    draft tokens proposed and accepted-and-emitted for this request when
+    the engine runs speculative decoding (both 0 otherwise) — the
+    per-request attribution behind the ``serve_spec_*`` gauges.
     """
 
     request_id: str
@@ -152,3 +155,5 @@ class RequestOutput:
     latency_s: float
     cached_prompt_tokens: int = 0
     prefill_chunks: int = 0
+    spec_proposed: int = 0
+    spec_accepted: int = 0

@@ -157,7 +157,24 @@ def serving_collector(registry: MetricsRegistry,
         "serve_gateway_breaker_trips_total": registry.gauge(
             "serve_gateway_breaker_trips_total",
             "per-replica circuit breaker open transitions"),
+        "serve_spec_steps_total": registry.gauge(
+            "serve_spec_steps_total",
+            "speculative (draft-and-verify) decode iterations run"),
+        "serve_spec_proposed_tokens_total": registry.gauge(
+            "serve_spec_proposed_tokens_total",
+            "draft tokens proposed across all speculative iterations"),
+        "serve_spec_accepted_tokens_total": registry.gauge(
+            "serve_spec_accepted_tokens_total",
+            "draft tokens accepted AND emitted"),
+        "serve_spec_acceptance_rate": registry.gauge(
+            "serve_spec_acceptance_rate",
+            "fraction of proposed draft tokens accepted and emitted"),
     }
+    spec_hist = registry.gauge(
+        "serve_spec_accepted_per_step",
+        "slot-iterations by accepted-draft count (0..spec_k) — the "
+        "acceptance distribution behind the mean rate",
+        labelnames=("accepted",))
     finished = registry.gauge(
         "serve_finished_total",
         "requests finished by reason (eos/length/timeout/abort/...) — "
@@ -184,7 +201,11 @@ def serving_collector(registry: MetricsRegistry,
                "gateway_dispatches": "serve_gateway_dispatches_total",
                "gateway_migrations": "serve_gateway_migrations_total",
                "gateway_hedges": "serve_gateway_hedges_total",
-               "gateway_breaker_trips": "serve_gateway_breaker_trips_total"}
+               "gateway_breaker_trips": "serve_gateway_breaker_trips_total",
+               "spec_steps": "serve_spec_steps_total",
+               "spec_proposed_tokens": "serve_spec_proposed_tokens_total",
+               "spec_accepted_tokens": "serve_spec_accepted_tokens_total",
+               "spec_acceptance_rate": "serve_spec_acceptance_rate"}
 
     def collect() -> None:
         summ = stats.summary()
@@ -194,6 +215,8 @@ def serving_collector(registry: MetricsRegistry,
                 g[dst].set(float(v))
         for reason, count in summ.get("finish_reasons", {}).items():
             finished.labels(reason=str(reason)).set(float(count))
+        for accepted, count in summ.get("spec_accept_hist", {}).items():
+            spec_hist.labels(accepted=str(accepted)).set(float(count))
 
     registry.register_collector(collect)
 

@@ -60,6 +60,9 @@ EVENTS: dict[str, str] = {
                               "is back in the routing set",
     "replica_drained": "a draining replica finished or migrated all of "
                        "its work (safe to terminate)",
+    "spec_summary": "end-of-run speculative-decoding aggregate: draft "
+                    "tokens proposed/accepted, acceptance rate, "
+                    "accepted-per-step histogram",
 }
 
 _SNAKE = re.compile(r"^[a-z][a-z0-9_]*$")

@@ -134,6 +134,14 @@ class ServingStats:
         self.gateway_migrations = 0
         self.gateway_hedges = 0
         self.gateway_breaker_trips = 0
+        # Speculative decoding (draft-and-verify): draft tokens proposed
+        # vs accepted-and-emitted, spec iterations run, and a histogram
+        # of accepted-draft count per slot-iteration (key 0..spec_k — the
+        # shape of the acceptance distribution, not just its mean).
+        self.spec_steps = 0
+        self.spec_proposed_tokens = 0
+        self.spec_accepted_tokens = 0
+        self.spec_accept_hist: dict[int, int] = {}
 
     def _tick(self) -> None:
         now = time.perf_counter()
@@ -151,11 +159,31 @@ class ServingStats:
         self._tick()
         self.ttft_s.append(ttft_s)
 
-    def record_step(self, active_slots: int, num_slots: int) -> None:
+    def record_step(self, active_slots: int, num_slots: int,
+                    tokens: int | None = None) -> None:
+        """One decode iteration. ``tokens`` overrides the emitted-token
+        count for the step (a speculative iteration emits between 1 and
+        spec_k + 1 tokens per active slot); None keeps the classic
+        one-per-active-slot accounting."""
         self._tick()
         self.steps += 1
-        self.decode_tokens += active_slots
+        self.decode_tokens += active_slots if tokens is None else int(tokens)
         self.occupancy_sum += active_slots / max(num_slots, 1)
+
+    def record_spec_step(self, proposed: int,
+                         accepted_counts: "list[int] | tuple[int, ...]"
+                         ) -> None:
+        """One speculative iteration: ``proposed`` draft tokens were
+        generated in total and ``accepted_counts`` holds each active
+        slot's accepted-and-emitted draft count (0..spec_k), binned into
+        the per-slot-step acceptance histogram."""
+        self._tick()
+        self.spec_steps += 1
+        self.spec_proposed_tokens += int(proposed)
+        for a in accepted_counts:
+            a = int(a)
+            self.spec_accepted_tokens += a
+            self.spec_accept_hist[a] = self.spec_accept_hist.get(a, 0) + 1
 
     def record_prefix_lookup(self, hit_tokens: int,
                              prompt_tokens: int) -> None:
@@ -262,6 +290,16 @@ class ServingStats:
             "gateway_migrations": self.gateway_migrations,
             "gateway_hedges": self.gateway_hedges,
             "gateway_breaker_trips": self.gateway_breaker_trips,
+            "spec_steps": self.spec_steps,
+            "spec_proposed_tokens": self.spec_proposed_tokens,
+            "spec_accepted_tokens": self.spec_accepted_tokens,
+            # Fraction of proposed drafts accepted AND emitted (None
+            # until the first speculative iteration).
+            "spec_acceptance_rate": (
+                round(self.spec_accepted_tokens / self.spec_proposed_tokens,
+                      4) if self.spec_proposed_tokens else None),
+            "spec_accept_hist": {str(k): v for k, v in
+                                 sorted(self.spec_accept_hist.items())},
             # Fraction of looked-up prompt tokens served from cached KV
             # (None until the first lookup, i.e. cache disabled or idle).
             "prefix_hit_rate": (

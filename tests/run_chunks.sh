@@ -61,9 +61,13 @@ CHUNKS[fleet]="tests/test_fleet.py"
 # compiling their own tiny models plus breaker-timing sleeps — its own
 # chunk so serve/sched stay under their timeouts.
 CHUNKS[gateway]="tests/test_gateway.py"
+# Speculative decoding bit-parity matrix + the Pallas paged decode-
+# attention kernel (interpret mode on CPU): both compile their own draft/
+# target engines, so they get their own chunk.
+CHUNKS[spec]="tests/test_spec.py tests/test_pallas_paged_attn.py"
 CHUNKS[slow1]="tests/test_train_e2e.py tests/test_multiprocess.py"
 CHUNKS[slow2]="tests/test_multihost_train.py tests/test_multihost_llama.py tests/test_train_zoo.py"
-ORDER=(lint core parallel1 parallel2 moe train llama deploy serve sched paged faults graftscope fleet gateway slow1 slow2)
+ORDER=(lint core parallel1 parallel2 moe train llama deploy serve sched paged faults graftscope fleet gateway spec slow1 slow2)
 
 # --- completeness check: every tests/test_*.py in EXACTLY one chunk ------
 # ...and every declared chunk actually in ORDER: a chunk missing from the

@@ -137,6 +137,30 @@ def test_chunked_prefill_parity_and_per_step_budget(med):
         _ref_greedy(model, params, long_p, 6))
 
 
+def test_concurrent_cold_chunked_prefills_parity(med):
+    """Several cold prompts admitted in the SAME step, chunk-prefilling
+    across iterations while the first finisher decodes: every decode
+    iteration writes a rider KV row for EVERY slot at that slot's cursor,
+    and a pending slot's cursor is stale (pre-admission). Its block-table
+    row must stay all-scratch until admission completes, or the rider
+    write lands inside the freshly prefilled prompt pages — regression
+    test: requests admitted later decoded from corrupted prompt KV."""
+    model, params, cfg = med
+    rng = np.random.default_rng(9)
+    prompts = [rng.integers(0, cfg.vocab_size,
+                            size=int(rng.integers(2 * BLOCK + 2,
+                                                  3 * BLOCK))).astype(
+                                np.int32) for _ in range(6)]
+    reqs = [Request(prompt=p, max_new_tokens=7) for p in prompts]
+    eng = ServeEngine(model, params, num_slots=3,
+                      prefill_chunk_tokens=BLOCK)
+    outs = {o.request_id: o for o in eng.run(reqs)}
+    for r, p in zip(reqs, prompts):
+        np.testing.assert_array_equal(
+            np.asarray(outs[r.request_id].tokens),
+            _ref_greedy(model, params, p, 7))
+
+
 def test_chunked_plus_prefix_cache_parity(med):
     """Both features on at once: pasted prefix blocks advance the chunk
     cursor, chunks resume after them, and greedy output still matches the

@@ -229,6 +229,46 @@ def test_invalid_tenants_fail_validation():
                    for e in errs), (bad, errs)
 
 
+def test_spec_renders_env_and_validates():
+    """JobConfig.draft_model/spec_k ride into the manifest as
+    TPUJOB_DRAFT_MODEL/TPUJOB_SPEC_K — the serving job's speculative-
+    decoding setup is fully described by the rendered object — and a
+    coherent pair passes offline validation; absence renders no env."""
+    from k8s_distributed_deeplearning_tpu.launch import validate
+
+    docs = render.render_all(JobConfig(num_workers=2, draft_model="micro",
+                                       spec_k=4))
+    env = {e["name"]: e for e in
+           docs[2]["spec"]["template"]["spec"]["containers"][0]["env"]}
+    assert env["TPUJOB_DRAFT_MODEL"]["value"] == "micro"
+    assert env["TPUJOB_SPEC_K"]["value"] == "4"
+    assert validate.validate(docs) == []
+    names = {e["name"] for e in render.render_all(JobConfig(num_workers=2))[
+        2]["spec"]["template"]["spec"]["containers"][0]["env"]}
+    assert "TPUJOB_DRAFT_MODEL" not in names
+    assert "TPUJOB_SPEC_K" not in names
+
+
+def test_invalid_spec_fails_validation():
+    """An unknown draft preset, a non-integer/zero spec_k, or a dangling
+    half of the pair is a render-time error, not a serving worker that
+    dies at startup on a scheduled TPU slice."""
+    from k8s_distributed_deeplearning_tpu.launch import validate
+
+    errs = validate.validate(render.render_all(
+        JobConfig(num_workers=2, draft_model="gigantic", spec_k=4)))
+    assert any("TPUJOB_DRAFT_MODEL" in e and "preset" in e for e in errs)
+    for bad_k in (0, -3):
+        errs = validate.validate(render.render_all(
+            JobConfig(num_workers=2, draft_model="micro", spec_k=bad_k)))
+        assert any("TPUJOB_SPEC_K" in e for e in errs), (bad_k, errs)
+    # draft preset without a draft count: the renderer emits an empty
+    # TPUJOB_SPEC_K, which must fail the integer check.
+    errs = validate.validate(render.render_all(
+        JobConfig(num_workers=2, draft_model="micro")))
+    assert any("TPUJOB_SPEC_K" in e for e in errs)
+
+
 def test_graceful_shutdown_renders_prestop_and_grace():
     """The serving-drain handshake as manifest fields: pre_stop_sleep_s
     renders an exec preStop hook (routing layer notices the pod leaving
