@@ -1533,6 +1533,73 @@ def measure_request_trace_overhead(n_requests: int = 8, num_slots: int = 4,
     }
 
 
+def measure_flight_overhead(n_requests: int = 8, num_slots: int = 4,
+                            out_len: int = 48, repeats: int = 10,
+                            seed: int = 0) -> dict:
+    """Flight-recorder overhead on the serving hot path: the engine run
+    with an enabled 256-deep snapshot ring (every step builds one
+    snapshot dict — queue/tenant depths, slot occupancy, pool counters
+    by owner class, spec acceptance, timings — and appends it to the
+    deque; the per-step perf_counter pairs around prefill/decode ride
+    along) vs ``flight=None`` (the epilogue's single ``is not None``
+    check). The owner-tagged page ledger itself is unconditional and
+    present in both modes, so the delta isolates what enabling the
+    recorder adds. Same drift-proof estimator as the request-trace
+    bench: paired back-to-back runs with alternating order, MEDIAN of
+    paired ratios. The telemetry-suite gate asserts < 2%."""
+    import os as _os  # noqa: F401 — parallel imports with siblings
+
+    import numpy as np
+
+    from k8s_distributed_deeplearning_tpu.serve import Request, ServeEngine
+    from k8s_distributed_deeplearning_tpu.telemetry.flight import (
+        FlightRecorder)
+
+    max_seq = 256
+    model, params, cfg, _ = _serve_cpu_model(max_seq)
+    rng = np.random.default_rng(seed)
+    prompts = [rng.integers(0, cfg.vocab_size, size=int(
+        rng.integers(32, 128))).astype(np.int32) for _ in range(n_requests)]
+
+    def run(flight_on: bool) -> tuple[float, int]:
+        fr = FlightRecorder(256) if flight_on else None
+        eng = ServeEngine(model, params, num_slots=num_slots,
+                          max_queue=n_requests, flight=fr)
+        reqs = [Request(prompt=p, max_new_tokens=out_len) for p in prompts]
+        t0 = time.perf_counter()
+        eng.run(reqs)
+        dt = (time.perf_counter() - t0) / max(eng.stats.steps, 1)
+        return dt, (len(fr.ring) if fr is not None else 0)
+
+    run(False)                                 # warmup replays (compiles)
+    run(True)
+    times = {False: float("inf"), True: float("inf")}
+    pcts = []
+    recorded = 0
+    for i in range(repeats):
+        pair = {}
+        for mode in ((False, True) if i % 2 == 0 else (True, False)):
+            dt, n = run(mode)
+            pair[mode] = dt
+            times[mode] = min(times[mode], dt)
+            if mode:
+                recorded = n
+        pcts.append((pair[True] - pair[False]) / pair[False] * 100.0)
+    pcts.sort()
+    mid = len(pcts) // 2
+    pct = (pcts[mid] if len(pcts) % 2 else (pcts[mid - 1] + pcts[mid]) / 2)
+    return {
+        "flight_overhead_pct": round(pct, 3),
+        "flight_paired_pcts": [round(p, 2) for p in pcts],
+        "serve_step_ms_no_flight": round(times[False] * 1e3, 4),
+        "serve_step_ms_flight": round(times[True] * 1e3, 4),
+        "flight_ring_records_last_window": recorded,
+        "flight_config": {"requests": n_requests, "slots": num_slots,
+                          "out_len": out_len, "ring_size": 256,
+                          "repeats": repeats},
+    }
+
+
 def measure_fleet_overhead(n_requests: int = 8, num_slots: int = 4,
                            out_len: int = 48, repeats: int = 10,
                            seed: int = 0) -> dict:
@@ -2035,6 +2102,7 @@ def main() -> None:
                                            warmup=args.warmup)
         extra.update(measure_request_trace_overhead())
         extra.update(measure_fleet_overhead())
+        extra.update(measure_flight_overhead())
         emit({
             "metric": "telemetry_overhead_pct",
             "value": extra["telemetry_overhead_pct"],
@@ -2042,8 +2110,9 @@ def main() -> None:
             "vs_baseline": None,
             "extra": extra})
         # Absolute gates, independent of the stored baseline: full-rate
-        # request-lifecycle sampling and a live 1 Hz fleet scrape must
-        # each cost < 2% of serve step time.
+        # request-lifecycle sampling, a live 1 Hz fleet scrape, and an
+        # enabled flight-recorder ring must each cost < 2% of serve
+        # step time.
         gates = []
         if extra["request_trace_overhead_pct"] >= 2.0:
             gates.append("GATE request_trace_overhead_pct: "
@@ -2051,6 +2120,9 @@ def main() -> None:
         if extra["fleet_overhead_pct"] >= 2.0:
             gates.append("GATE fleet_overhead_pct: "
                          f"{extra['fleet_overhead_pct']} >= 2.0")
+        if extra["flight_overhead_pct"] >= 2.0:
+            gates.append("GATE flight_overhead_pct: "
+                         f"{extra['flight_overhead_pct']} >= 2.0")
         for g in gates:
             print(g, file=sys.stderr)
         if gates:

@@ -140,6 +140,14 @@ class JobConfig:
     # domain offline, before anything is applied to a cluster.
     draft_model: str | None = None
     spec_k: int | None = None
+    # Flight recorder for serving workers: ring size carried as
+    # $TPUJOB_FLIGHT_RING and the dump directory as $TPUJOB_FLIGHT_DIR
+    # (serve/cli.py --flight-ring/--flight-dir). The dir is optional —
+    # without it dumps stay in memory behind /debug/flight — but a dir
+    # without a ring is meaningless; validate.py enforces that and the
+    # integer domain offline.
+    flight_ring: int | None = None
+    flight_dir: str | None = None
     # preStop sleep: delay SIGTERM by this many seconds so the endpoint/
     # gateway routing layer observes the pod leaving the ready set and
     # stops sending NEW requests before the drain starts (the classic

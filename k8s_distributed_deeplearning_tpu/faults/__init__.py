@@ -11,6 +11,7 @@ from k8s_distributed_deeplearning_tpu.faults.inject import (  # noqa: F401
     FaultInjector,
     activate,
     active,
+    add_fire_hook,
     deactivate,
 )
 from k8s_distributed_deeplearning_tpu.faults.plan import (  # noqa: F401

@@ -26,6 +26,7 @@ import os
 import signal
 import sys
 import time
+import weakref
 from typing import Callable
 
 from k8s_distributed_deeplearning_tpu.faults.plan import Fault, FaultPlan
@@ -77,6 +78,11 @@ class FaultInjector:
             if not self._triggered(i, f, step):
                 continue
             self.fired.append((site, f.action))
+            # Last-gasp hooks run BEFORE the action executes: "exit" is an
+            # immediate os._exit and "sigterm"/"ioerror" unwind the caller,
+            # so this is the only instant a flight recorder can still dump
+            # the black box of the process the fault is about to kill.
+            _run_fire_hooks(site, f.action)
             self._execute(f, path)
 
     def suppressed(self, site: str, *, step: int | None = None) -> bool:
@@ -146,6 +152,37 @@ def damage_newest_checkpoint(directory: str, *, mode: str = "truncate"
             f.seek(vsize // 2)
             f.write(bytes(b ^ 0xFF for b in run))
     return victim
+
+
+# Last-gasp observers (weakrefs): objects whose ``_on_fault(site, action)``
+# runs between a fault's trigger bookkeeping and its execution. The flight
+# recorder's dump-on-injected-fault path — registered by components (engine,
+# gateway) that own a recorder, dropped automatically when they die. Hook
+# errors are swallowed: forensics must never mask the fault under test.
+_fire_hooks: list["weakref.ref"] = []
+
+
+def add_fire_hook(obj) -> None:
+    """Register ``obj._on_fault(site, action)`` as a last-gasp observer.
+    Held by weakref — no unregister needed."""
+    _fire_hooks.append(weakref.ref(obj))
+
+
+def _run_fire_hooks(site: str, action: str) -> None:
+    if not _fire_hooks:
+        return
+    for r in list(_fire_hooks):
+        obj = r()
+        if obj is None:
+            try:
+                _fire_hooks.remove(r)
+            except ValueError:
+                pass
+            continue
+        try:
+            obj._on_fault(site, action)
+        except Exception:
+            pass
 
 
 # Process-global activation cache. _resolved distinguishes "not yet looked

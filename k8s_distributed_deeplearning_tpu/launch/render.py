@@ -86,6 +86,14 @@ def render_tpujob(cfg: JobConfig) -> dict:
         env.append({"name": "TPUJOB_DRAFT_MODEL", "value": cfg.draft_model})
     if cfg.spec_k is not None:
         env.append({"name": "TPUJOB_SPEC_K", "value": str(cfg.spec_k)})
+    # Flight recorder for serving workers (serve/cli.py --flight-ring/
+    # --flight-dir): each half renders independently so a dangling dir
+    # is VISIBLE in the manifest — validate.py flags it offline.
+    if cfg.flight_ring is not None:
+        env.append({"name": "TPUJOB_FLIGHT_RING",
+                    "value": str(cfg.flight_ring)})
+    if cfg.flight_dir is not None:
+        env.append({"name": "TPUJOB_FLIGHT_DIR", "value": cfg.flight_dir})
     container = {
         "name": "worker",
         "image": cfg.image,

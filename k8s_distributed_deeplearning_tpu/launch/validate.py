@@ -73,6 +73,7 @@ def _check_container(errors, where: str, c: dict) -> None:
     _check_tenants(errors, where, c)
     _check_fleet_endpoints(errors, where, c)
     _check_spec(errors, where, c)
+    _check_flight(errors, where, c)
 
 
 def _hooked_sites() -> frozenset[str]:
@@ -208,6 +209,34 @@ def _check_spec(errors, where: str, c: dict) -> None:
         if not raw.isdigit() or int(raw) < 1:
             _err(errors, where, f"TPUJOB_SPEC_K {raw!r} must be an "
                  "integer >= 1")
+
+
+def _check_flight(errors, where: str, c: dict) -> None:
+    """A manifest carrying flight-recorder env must be COHERENT offline:
+    $TPUJOB_FLIGHT_RING must be an integer >= 0 (0 renders but disables),
+    and $TPUJOB_FLIGHT_DIR without a ring (or with ring 0) is a config
+    that silently records nothing — the postmortem you reach for after
+    the incident would not exist."""
+    env = {e.get("name"): e for e in c.get("env", [])}
+    ring = env.get("TPUJOB_FLIGHT_RING")
+    fdir = env.get("TPUJOB_FLIGHT_DIR")
+    if ring is None and fdir is None:
+        return
+    ring_val = None
+    if ring is not None:
+        raw = (ring.get("value") or "").strip()
+        if not raw.isdigit():
+            _err(errors, where, f"TPUJOB_FLIGHT_RING {raw!r} must be an "
+                 "integer >= 0")
+        else:
+            ring_val = int(raw)
+    if fdir is not None:
+        if not (fdir.get("value") or "").strip():
+            _err(errors, where, "TPUJOB_FLIGHT_DIR is empty")
+        if ring is None or ring_val == 0:
+            _err(errors, where, "TPUJOB_FLIGHT_DIR without an enabled "
+                 "TPUJOB_FLIGHT_RING records nothing — set a ring size "
+                 ">= 1 or drop the dir")
 
 
 _PRESTOP_SLEEP = re.compile(r"\bsleep\s+(\d+)\b")

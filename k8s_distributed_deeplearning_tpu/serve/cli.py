@@ -19,6 +19,18 @@ import argparse
 import sys
 
 
+def _drain_status(engines) -> dict:
+    """/healthz body for the serving process: ``status`` is the preStop
+    hook's one-word answer — "ok" until drain() is called, "draining"
+    while any replica still holds work, "drained" once everything
+    finished (safe to kill)."""
+    draining = any(e.draining for e in engines)
+    drained = all(e.drained for e in engines)
+    return {"status": ("drained" if draining and drained
+                       else "draining" if draining else "ok"),
+            "draining": draining, "drained": drained}
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(
         prog="launch serve",
@@ -111,6 +123,17 @@ def main(argv: list[str] | None = None) -> int:
                          "an in-memory ring of recent spans, "
                          "/debug/profile?ms=N captures a windowed "
                          "jax.profiler trace into DIR")
+    ap.add_argument("--flight-ring", type=int, default=0, metavar="N",
+                    help="black-box flight recorder: keep the last N "
+                         "per-step engine/gateway snapshots in memory and "
+                         "dump them as JSONL on breaker trip, drain "
+                         "completion, SIGTERM, injected fault, or "
+                         "/debug/flight?dump=1 (0 = off); read dumps with "
+                         "`graftscope postmortem`")
+    ap.add_argument("--flight-dir", default=None, metavar="DIR",
+                    help="directory for flight-recorder dump files "
+                         "(requires --flight-ring; omitted = dumps stay "
+                         "in memory, visible only via /debug/flight)")
     args = ap.parse_args(argv)
 
     # Flag validation BEFORE the heavy imports/model build: a bad flag
@@ -151,6 +174,11 @@ def main(argv: list[str] | None = None) -> int:
                  f"--spec-k {args.spec_k})")
     if args.spec_k < 0:
         ap.error(f"--spec-k must be >= 1 (0 = off), got {args.spec_k}")
+    if args.flight_ring < 0:
+        ap.error(f"--flight-ring must be >= 0, got {args.flight_ring}")
+    if args.flight_dir is not None and not args.flight_ring:
+        ap.error("--flight-dir requires --flight-ring >= 1 (there is "
+                 "nothing to dump with the recorder off)")
 
     import signal
 
@@ -215,6 +243,14 @@ def main(argv: list[str] | None = None) -> int:
     sampling = SamplingParams(temperature=args.temperature,
                               top_k=args.top_k, top_p=args.top_p)
     logger = MetricsLogger(job="serve", path=args.metrics_path)
+    flight = None
+    if args.flight_ring:
+        from k8s_distributed_deeplearning_tpu.telemetry.flight import (
+            FlightRecorder)
+        # ONE recorder shared by every replica and the gateway: the dump
+        # is the whole process's flight path, sources interleaved.
+        flight = FlightRecorder(args.flight_ring, dump_dir=args.flight_dir,
+                                logger=logger, job="serve")
     tracer = None
     if args.trace or args.debug_dir is not None:
         from k8s_distributed_deeplearning_tpu.telemetry.trace import Tracer
@@ -239,14 +275,15 @@ def main(argv: list[str] | None = None) -> int:
             request_trace_sample=args.request_trace_sample,
             request_log=logger, stats=stats,
             draft_model=draft_model, draft_params=draft_params,
-            spec_k=args.spec_k,
+            spec_k=args.spec_k, flight=flight,
             replica_id=f"r{i}" if args.replicas > 1 else None)
         for i in range(args.replicas)]
     engine = engines[0]
     gateway = None
     if args.replicas > 1:
         gateway = ServeGateway(engines, stats=stats, logger=logger,
-                               hedge_after_s=args.hedge_after_s)
+                               hedge_after_s=args.hedge_after_s,
+                               flight=flight)
     front = gateway if gateway is not None else engine
 
     # SIGTERM → cooperative drain → exit 0: the k8s eviction handshake.
@@ -259,6 +296,10 @@ def main(argv: list[str] | None = None) -> int:
     def _on_sigterm(signum, frame):
         nonlocal drain_requested
         drain_requested = True
+        # Dump the black box at signal receipt — the state the eviction
+        # interrupted — before drain mode starts changing it.
+        if flight is not None:
+            flight.dump("sigterm")
         for e in engines:
             e.drain()
 
@@ -285,10 +326,8 @@ def main(argv: list[str] | None = None) -> int:
         exporter = MetricsExporter(
             registry, port=args.metrics_port,
             tracer=tracer if args.debug_dir is not None else None,
-            profile_dir=args.debug_dir,
-            healthz=lambda: {
-                "draining": any(e.draining for e in engines),
-                "drained": all(e.drained for e in engines)}).start()
+            profile_dir=args.debug_dir, flight=flight,
+            healthz=lambda: _drain_status(engines)).start()
     shared = rng.integers(0, cfg.vocab_size, size=args.shared_prefix_len)
     tenant_ids = engine.queue.tenant_ids()
     from collections import deque

@@ -384,7 +384,7 @@ class ServeEngine:
                  request_log: "Any | None" = None,
                  replica_id: str | None = None,
                  draft_model=None, draft_params: PyTree | None = None,
-                 spec_k: int = 0):
+                 spec_k: int = 0, flight: "Any | None" = None):
         if num_slots < 2:
             raise ValueError(f"num_slots must be >= 2, got {num_slots}")
         cfg = getattr(model, "cfg", None)
@@ -456,6 +456,20 @@ class ServeEngine:
         # Identity in a multi-replica deployment (gateway routing,
         # request_trace replica= field). None for standalone engines.
         self.replica_id = replica_id
+        # Black-box flight recorder (telemetry/flight.py): one per-step
+        # snapshot into the shared ring, dumped on drain completion or an
+        # injected fault. None = off; the hot path gates every snapshot
+        # assembly on it.
+        self.flight = flight
+        self._last_decode_ms: float | None = None
+        self._last_prefill_ms: float | None = None
+        self._drain_finalized = False
+        if flight is not None:
+            # Dump the ring when a fault fires anywhere in-process —
+            # including actions (exit/sigterm) that never return control
+            # to the serving loop. Weakref-registered, so dead engines
+            # fall out of the hook list on their own.
+            _faults.add_fire_hook(self)
         self._draining = False
         self.queue = TenantScheduler(tenants, default_max_queue=max_queue)
         # Page geometry: the trie's block size IS the pool's page size
@@ -515,7 +529,7 @@ class ServeEngine:
             self.prefix_cache = PrefixCache(
                 int(prefix_cache_mb * 2 ** 20), block_tokens=self.page_tokens,
                 block_nbytes=self._block_nbytes(self.page_tokens),
-                release_page=self.pool.deref)
+                release_page=self._release_trie_page)
         # Per-step accounting for the chunked-prefill work bound (tested:
         # real prefill tokens per iteration never exceed the chunk budget).
         self.last_step_prefill_tokens = 0
@@ -722,6 +736,8 @@ class ServeEngine:
             outputs.append(self._timeout_unadmitted(req))
         self.last_step_prefill_tokens = 0
         self._step_prefill_budget = self.prefill_chunk_tokens
+        flight_on = self.flight is not None and self.flight.enabled
+        t_pf = time.perf_counter() if flight_on else 0.0
         # Admission and prefill alternate until neither makes progress:
         # a request that finishes AT admission (first token is EOS /
         # max_new_tokens == 1) frees its slot AND its pages for the next
@@ -731,9 +747,12 @@ class ServeEngine:
             freed = self._run_prefills(outputs)
             if not (freed and len(self.queue)):
                 break
+        if flight_on and self.last_step_prefill_tokens:
+            self._last_prefill_ms = round(
+                (time.perf_counter() - t_pf) * 1e3, 3)
         active = sum(s is not None for s in self._slots)
         if active == 0:
-            self._record_pool_gauges()
+            self._step_epilogue()
             return outputs
         # Decode-growth pages: a slot whose next write positions cross
         # into unmapped blocks claims from ITS reserved pages —
@@ -759,9 +778,13 @@ class ServeEngine:
         inj = _faults.active()
         if inj is not None:
             inj.fire("serve_decode")
+        t_dec = time.perf_counter() if flight_on else 0.0
         if self.spec_k:
             self._spec_decode(active, outputs)
-            self._record_pool_gauges()
+            if flight_on:
+                self._last_decode_ms = round(
+                    (time.perf_counter() - t_dec) * 1e3, 3)
+            self._step_epilogue()
             return outputs
         with self.tracer.span("decode", active=active):
             nxt, keys, self._cache = _decode_program(
@@ -776,6 +799,9 @@ class ServeEngine:
             # in place.
             # graftlint: disable=host-sync — rides the same fence as nxt
             self._keys = np.array(keys)
+        if flight_on:
+            self._last_decode_ms = round(
+                (time.perf_counter() - t_dec) * 1e3, 3)
         self.stats.record_step(active, self.num_slots)
         for slot, fl in enumerate(self._slots):
             if fl is None:
@@ -792,7 +818,7 @@ class ServeEngine:
                 outputs.append(self._finish(slot, "eos"))
             elif len(fl.tokens) >= fl.req.max_new_tokens:
                 outputs.append(self._finish(slot, "length"))
-        self._record_pool_gauges()
+        self._step_epilogue()
         return outputs
 
     # graftlint: hot-path
@@ -929,6 +955,11 @@ class ServeEngine:
         for slot, fl in enumerate(self._slots):
             if fl is not None:
                 outs.append(self._finish(slot, "aborted"))
+        # Leak guard: everything above released its pages; anything still
+        # live (after flushing the trie's cache retention) is a leak.
+        # Runs on every shutdown — the breaker-trip evacuation path and
+        # plain teardown both get the check for free.
+        self._check_page_leaks("shutdown")
         return outs
 
     def decode_cache_size(self) -> int:
@@ -979,7 +1010,92 @@ class ServeEngine:
     def _record_pool_gauges(self) -> None:
         c = self.pool.counters()
         self.stats.record_kv_pool(c["pages_total"], c["pages_used"],
-                                  c["pages_shared"])
+                                  c["pages_shared"],
+                                  by_owner=self.pool.owners_summary())
+
+    def _step_epilogue(self) -> None:
+        """Every :meth:`step` return path funnels here: refresh the pool
+        gauges, append this step's flight-recorder snapshot, and — once a
+        draining engine runs out of work — run the one-shot drain
+        finalization (page-leak check + flight dump)."""
+        self._record_pool_gauges()
+        fr = self.flight
+        if fr is not None and fr.enabled:
+            depths = getattr(self.queue, "depths", None)
+            s = self.stats
+            fr.record(
+                f"engine:{self.replica_id or 'serve'}",
+                step=s.steps,
+                queued=len(self.queue),
+                tenant_depths=depths() if depths is not None else {},
+                pending_prefills=len(self._pending),
+                occupied_slots=self.occupied_slots(),
+                pool={"used": s.kv_pages_used, "total": s.kv_pages_total,
+                      "shared": s.kv_pages_shared,
+                      "reserved": self.pool.reserved},
+                pool_owners=dict(s.kv_pages_by_owner),
+                spec_proposed=s.spec_proposed_tokens,
+                spec_accepted=s.spec_accepted_tokens,
+                last_decode_ms=self._last_decode_ms,
+                last_prefill_ms=self._last_prefill_ms,
+                draining=self._draining)
+        if self._draining and not self._drain_finalized and not self.busy():
+            self._drain_finalized = True
+            leak = self._check_page_leaks("drain")
+            if fr is not None:
+                fr.dump("drain", extra=self._flight_extra(leak))
+
+    def _release_trie_page(self, page: int) -> None:
+        """Trie eviction callback: drop the trie's pool reference and,
+        when a decode slot still maps the page, hand the ledger
+        attribution back to it (the slot's reference now owns the
+        lifetime)."""
+        self.pool.deref(page)
+        if self.pool.refcount(page):
+            self.pool.tag(page, "slot")
+
+    def _check_page_leaks(self, origin: str) -> dict | None:
+        """Drain/shutdown leak guard: once every request is terminal,
+        nothing is pinned — flush the prefix trie (a cache is retention,
+        not a leak; cold is correct on a replica about to die), then any
+        page still live or reservation still outstanding is a genuine
+        accounting leak. Emits a registry-checked ``kv_page_leak`` event
+        with by-owner attribution and returns the leak record (None when
+        clean)."""
+        if self.prefix_cache is not None:
+            while self.prefix_cache.evict_lru_unpinned():
+                pass
+        self._record_pool_gauges()
+        c = self.pool.counters()
+        if not c["pages_used"] and not self.pool.reserved:
+            return None
+        info = {"origin": origin,
+                "replica": self.replica_id,
+                "pages_leaked": c["pages_used"],
+                "pages_reserved": self.pool.reserved,
+                "by_owner": self.pool.owners_summary(),
+                "pages_held": self.pool.held_pages()}
+        if self.request_log is not None:
+            self.request_log.emit("kv_page_leak", **info)
+        return info
+
+    def _flight_extra(self, leak: dict | None = None) -> dict:
+        """Terminal context stamped into a flight-dump header: who holds
+        the pool right now, by owner class and by page id."""
+        extra = {"replica": self.replica_id,
+                 "pool": self.pool.counters(),
+                 "pages_by_owner": self.pool.owners_summary(),
+                 "pages_held": self.pool.held_pages()}
+        if leak is not None:
+            extra["leak"] = leak
+        return extra
+
+    def _on_fault(self, site: str, action: str) -> None:
+        """faults.add_fire_hook callback: an injected fault is about to
+        execute (possibly ``os._exit``) — capture the black box NOW."""
+        if self.flight is not None:
+            self.flight.dump("fault", extra={
+                "site": site, "action": action, **self._flight_extra()})
 
     def _timeout_unadmitted(self, req: Request) -> RequestOutput:
         """Terminal output for a request whose deadline expired while it
@@ -1019,6 +1135,7 @@ class ServeEngine:
         self.request_log.emit(
             "request_trace",
             request_id=out.request_id,
+            trace_id=req.trace_id,
             replica=self.replica_id,
             migrated_from=req.migrated_from,
             tenant=req.tenant,
@@ -1214,6 +1331,9 @@ class ServeEngine:
                 def page_for_block(i: int) -> int:
                     page = int(self._tables[slot, i])
                     self.pool.ref(page)
+                    # Ledger: the trie's reference outlives the slot, so
+                    # the attribution moves with the longer lifetime.
+                    self.pool.tag(page, "trie")
                     return page
 
                 _, evicted = self.prefix_cache.insert(

@@ -172,7 +172,8 @@ class ServeGateway:
                  max_hedges: int = 1,
                  stats: ServingStats | None = None,
                  logger: MetricsLogger | None = None,
-                 clock: Callable[[], float] = time.perf_counter):
+                 clock: Callable[[], float] = time.perf_counter,
+                 flight=None):
         if not replicas:
             raise ValueError("gateway needs at least one replica")
         if failures_to_trip < 1:
@@ -195,6 +196,13 @@ class ServeGateway:
         self.max_hedges = max_hedges
         self.stats = stats if stats is not None else ServingStats()
         self.logger = logger
+        # Flight recorder (telemetry/flight.py): the gateway records the
+        # breaker/routing view each step and dumps the ring on a breaker
+        # trip — BEFORE evacuation tears the victim engine down, so the
+        # dump still names the pages held at death. None = off.
+        self.flight = flight
+        if flight is not None:
+            _faults.add_fire_hook(self)
         self._clock = clock
         self._replicas: list[_Replica] = []
         self._by_rid: dict[str, _Replica] = {}
@@ -274,6 +282,16 @@ class ServeGateway:
                 h.drained_emitted = True
                 if self.logger is not None:
                     self.logger.emit("replica_drained", replica=h.rid)
+        if self.flight is not None and self.flight.enabled:
+            self.flight.record(
+                "gateway",
+                breakers={h.rid: h.state for h in self._replicas},
+                draining=[h.rid for h in self._replicas if h.draining],
+                live_requests=len(self._live),
+                replica_load={
+                    h.rid: int(load())
+                    for h in self._replicas
+                    if (load := getattr(h.engine, "load", None)) is not None})
         self._maybe_hedge(self._clock())
         out, self._completed = self._completed, []
         return out
@@ -534,7 +552,46 @@ class ServeGateway:
         if self.logger is not None:
             self.logger.emit("gateway_breaker_open", replica=h.rid,
                              reason=why, retry_in_s=round(h.backoff, 3))
+        if self.flight is not None:
+            # Capture the black box NOW — _evacuate shuts the victim
+            # engine down, which derefs every page it holds; the dump
+            # must name who held memory at the moment of death.
+            self.flight.dump("breaker_trip",
+                             extra=self._flight_extra(h, why))
         self._evacuate(h, kill=True)
+
+    def _flight_extra(self, h: _Replica | None = None,
+                      why: str | None = None) -> dict:
+        """Terminal context for a flight-dump header: every breaker's
+        state plus — when a specific replica is dying — its reason and
+        its pool's page ledger. getattr-guarded so stub engines/pools
+        (tests) without the ledger surface still dump cleanly."""
+        extra: dict = {
+            "breakers": {r.rid: r.state for r in self._replicas},
+            "live_requests": len(self._live),
+        }
+        if h is not None:
+            extra["replica"] = h.rid
+            extra["trip_error"] = why
+            pool = getattr(h.engine, "pool", None)
+            if pool is not None:
+                counters = getattr(pool, "counters", None)
+                owners = getattr(pool, "owners_summary", None)
+                held = getattr(pool, "held_pages", None)
+                if counters is not None:
+                    extra["pool"] = counters()
+                if owners is not None:
+                    extra["pages_by_owner"] = owners()
+                if held is not None:
+                    extra["pages_held"] = held()
+        return extra
+
+    def _on_fault(self, site: str, action: str) -> None:
+        """faults.add_fire_hook callback: dump the routing/breaker view
+        before an injected fault (possibly ``os._exit``) executes."""
+        if self.flight is not None:
+            self.flight.dump("fault", extra={
+                "site": site, "action": action, **self._flight_extra()})
 
     # ---------------------------------------------------------- migration
 

@@ -18,14 +18,28 @@ a mid-decode page-boundary allocation (:meth:`alloc_reserved`) can never
 fail — back-pressure exists only at admission, where the scheduler's
 ``fits`` probe checks :meth:`available` before popping a request.
 
+The page LEDGER rides on the same bookkeeping: every live page carries an
+owner tag (``slot``/``trie``/``draft``/``scratch``) so the telemetry bridge
+can export per-owner gauges and a flight-recorder dump can answer "who held
+memory when it died" — attribution, not accounting; refcounts stay the
+source of truth for liveness.
+
 Pure Python/NumPy over small arrays — no device traffic; the device pool
 itself lives in the engine's cache pytree.
 """
 from __future__ import annotations
 
-__all__ = ["PagePool"]
+__all__ = ["PagePool", "OWNERS"]
 
 import numpy as np
+
+# Owner vocabulary for the page ledger. A page has exactly one tag at a
+# time — shared pages (slot table + trie node) are tagged "trie" because
+# the trie's reference is the one that outlives the slot. "draft" exists
+# for a future separately-allocated draft arena; today the draft cache
+# shares the target's pages (same indices, same tables), so it stays 0.
+OWNERS = ("free", "slot", "trie", "draft", "scratch")
+_OWNER_CODE = {name: i for i, name in enumerate(OWNERS)}
 
 
 class PagePool:
@@ -50,6 +64,10 @@ class PagePool:
         self.page_tokens = int(page_tokens)
         self._refs = np.zeros(self.num_pages, np.int32)
         self._refs[0] = 1          # scratch: pinned forever
+        # Page ledger: one owner code per page (see OWNERS). Free pages
+        # carry code 0; attribution only, refcounts own liveness.
+        self._owner = np.zeros(self.num_pages, np.int8)
+        self._owner[0] = _OWNER_CODE["scratch"]
         # LIFO free list: recently-freed pages are re-issued first (their
         # device lines are most likely still resident).
         self._free = list(range(self.num_pages - 1, 0, -1))
@@ -58,11 +76,11 @@ class PagePool:
     # ---- allocation ------------------------------------------------------
 
     # graftlint: hot-path
-    def alloc(self, n: int) -> list[int]:
-        """Pop ``n`` fresh pages (refcount 1 each). Raises ``RuntimeError``
-        on exhaustion — callers gate on :meth:`available` first (the
-        scheduler's ``fits`` probe), so hitting this means an accounting
-        bug, not load."""
+    def alloc(self, n: int, owner: str = "slot") -> list[int]:
+        """Pop ``n`` fresh pages (refcount 1 each), tagged ``owner``.
+        Raises ``RuntimeError`` on exhaustion — callers gate on
+        :meth:`available` first (the scheduler's ``fits`` probe), so
+        hitting this means an accounting bug, not load."""
         if n > len(self._free) - self.reserved:
             raise RuntimeError(
                 f"page pool exhausted: want {n}, have "
@@ -70,9 +88,10 @@ class PagePool:
                 f"(admission must gate on available())")
         pages = [self._free.pop() for _ in range(n)]
         self._refs[pages] = 1
+        self._owner[pages] = _OWNER_CODE[owner]
         return pages
 
-    def alloc_reserved(self, n: int) -> list[int]:
+    def alloc_reserved(self, n: int, owner: str = "slot") -> list[int]:
         """Pop ``n`` pages against an existing reservation (decode growth).
         Infallible by construction: admission reserved these pages."""
         if n > self.reserved:
@@ -82,6 +101,7 @@ class PagePool:
         self.reserved -= n
         pages = [self._free.pop() for _ in range(n)]
         self._refs[pages] = 1
+        self._owner[pages] = _OWNER_CODE[owner]
         return pages
 
     # ---- refcounts -------------------------------------------------------
@@ -99,7 +119,19 @@ class PagePool:
             raise RuntimeError(f"deref() on dead or scratch page {page}")
         self._refs[page] -= 1
         if self._refs[page] == 0:
+            self._owner[page] = 0
             self._free.append(page)
+
+    def tag(self, pages: int | list[int], owner: str) -> None:
+        """Re-attribute live page(s) to ``owner`` (e.g. a freshly-prefilled
+        slot block adopted by the trie). Ledger only — refcounts unchanged."""
+        code = _OWNER_CODE[owner]
+        if isinstance(pages, int):
+            pages = [pages]
+        for p in pages:
+            if p <= 0 or self._refs[p] == 0:
+                raise RuntimeError(f"tag() on dead or scratch page {p}")
+            self._owner[p] = code
 
     # ---- reservations ----------------------------------------------------
 
@@ -125,6 +157,10 @@ class PagePool:
         """Pages an admission may claim right now (free minus reserved)."""
         return len(self._free) - self.reserved
 
+    def refcount(self, page: int) -> int:
+        """Current reference count of *page* (0 = free)."""
+        return int(self._refs[page])
+
     def counters(self) -> dict:
         """Utilization snapshot (scratch page excluded throughout)."""
         used = int(np.count_nonzero(self._refs[1:]))
@@ -134,3 +170,29 @@ class PagePool:
             "pages_shared": int(np.count_nonzero(self._refs[1:] >= 2)),
             "pages_reserved": self.reserved,
         }
+
+    def owners_summary(self) -> dict:
+        """Ledger snapshot: live-page count per owner class, plus the
+        reservation headroom as its own pseudo-owner (``reserved`` pages
+        are free pages promised to running slots — memory that is spoken
+        for even though no page id is bound yet). Cheap enough for the
+        per-step flight-recorder path (one bincount over int8)."""
+        counts = np.bincount(self._owner[1:], minlength=len(OWNERS))
+        out = {name: int(counts[code])
+               for name, code in _OWNER_CODE.items()
+               if name not in ("free", "scratch")}
+        out["reserved"] = self.reserved
+        return out
+
+    def held_pages(self) -> dict:
+        """Dump-time forensics: owner class -> sorted live page ids.
+        O(num_pages) with list materialization — postmortem only, never
+        on the per-step path."""
+        out: dict[str, list[int]] = {}
+        for name, code in _OWNER_CODE.items():
+            if name in ("free", "scratch"):
+                continue
+            held = np.nonzero((self._owner == code) & (self._refs > 0))[0]
+            if held.size:
+                out[name] = [int(p) for p in held]
+        return out

@@ -10,9 +10,12 @@ from __future__ import annotations
 
 import dataclasses
 import itertools
+import os
 from typing import Callable, Sequence
 
 _req_counter = itertools.count()
+_trace_counter = itertools.count()
+_PID_TAG = f"{os.getpid():x}"
 
 
 class QueueFull(RuntimeError):
@@ -82,6 +85,15 @@ class Request:
     # the request_trace so a request's lifecycle is visible across
     # replicas). None for first-dispatch requests.
     migrated_from: str | None = None
+    # Stable cross-replica trace identity: unlike request_id (which the
+    # caller may reuse across unrelated submissions), trace_id is minted
+    # once per logical request and survives resume_from_tokens verbatim
+    # (dataclasses.replace copies it), so graftscope can stitch a migrated
+    # request's gateway->replica->survivor hops from per-replica JSONL
+    # into one timeline. Process-unique via the counter, globally
+    # disambiguated by the pid suffix.
+    trace_id: str = dataclasses.field(
+        default_factory=lambda: f"tr-{next(_trace_counter)}-{_PID_TAG}")
     # Stamped by ServeEngine.submit (perf_counter clock); queue wait and
     # TTFT are measured from this instant.
     _t_submit: float | None = dataclasses.field(

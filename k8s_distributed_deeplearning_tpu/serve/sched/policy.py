@@ -369,6 +369,13 @@ class TenantScheduler:
     def tenant_ids(self) -> tuple[str, ...]:
         return tuple(self._tenants)
 
+    def depths(self) -> dict[str, int]:
+        """Per-tenant queue depth only — the flight recorder's per-step
+        snapshot path. :meth:`snapshot` sorts wait percentiles and is too
+        heavy to run every engine step; this is one len() per tenant."""
+        return {tid: len(ts.heap) for tid, ts in self._tenants.items()
+                if ts.heap}
+
     def priority_of(self, tenant_id: str | None) -> str | None:
         """The priority class a tenant's requests run under (None for an
         unregistered tenant) — stamped onto ``request_trace`` events so

@@ -180,6 +180,11 @@ def serving_collector(registry: MetricsRegistry,
         "requests finished by reason (eos/length/timeout/abort/...) — "
         "the SLO availability ratio's numerator and denominator",
         labelnames=("reason",))
+    pages_by_owner = registry.gauge(
+        "serve_kv_pages_by_owner",
+        "live KV pool pages by ledger owner class (slot/trie/draft) plus "
+        "the reserved decode-growth headroom — who holds memory right now",
+        labelnames=("owner",))
     key_map = {"requests_admitted": "serve_requests_admitted",
                "requests_completed": "serve_requests_completed",
                "tokens_per_sec": "serve_tokens_per_sec",
@@ -217,6 +222,8 @@ def serving_collector(registry: MetricsRegistry,
             finished.labels(reason=str(reason)).set(float(count))
         for accepted, count in summ.get("spec_accept_hist", {}).items():
             spec_hist.labels(accepted=str(accepted)).set(float(count))
+        for owner, count in summ.get("kv_pages_by_owner", {}).items():
+            pages_by_owner.labels(owner=str(owner)).set(float(count))
 
     registry.register_collector(collect)
 

@@ -63,6 +63,12 @@ EVENTS: dict[str, str] = {
     "spec_summary": "end-of-run speculative-decoding aggregate: draft "
                     "tokens proposed/accepted, acceptance rate, "
                     "accepted-per-step histogram",
+    "flight_dump": "the flight recorder wrote (or was asked for) a ring "
+                   "dump: reason (breaker_trip/drain/sigterm/fault/"
+                   "on_demand), record count, dump path",
+    "kv_page_leak": "drain/shutdown leak guard: non-scratch KV pages "
+                    "still held after the engine released everything "
+                    "(count and by-owner attribution attached)",
 }
 
 _SNAKE = re.compile(r"^[a-z][a-z0-9_]*$")

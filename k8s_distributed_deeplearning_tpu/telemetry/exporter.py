@@ -21,6 +21,10 @@ owning process wired them in):
   directory. One capture at a time (concurrent requests get a 409); the
   window runs on the scrape's handler thread so the train/serve loop
   never blocks on it.
+- ``/debug/flight`` — JSON view of the flight recorder's snapshot ring
+  (``?dump=1`` additionally writes a JSONL dump file, reason
+  ``on_demand``, and reports its path) — the live black box, readable
+  before anything has died.
 """
 from __future__ import annotations
 
@@ -72,7 +76,7 @@ class MetricsExporter:
                  healthz: Callable[[], dict] | None = None,
                  tracer=None, profile_dir: str | None = None,
                  profiler: Callable | None = None,
-                 fleet=None, slo=None,
+                 fleet=None, slo=None, flight=None,
                  handler_timeout: float = 30.0):
         self.registry = registry
         self.healthz = healthz
@@ -81,6 +85,8 @@ class MetricsExporter:
         self._profiler = profiler
         self.fleet = fleet
         self.slo = slo
+        # telemetry.flight.FlightRecorder — enables /debug/flight.
+        self.flight = flight
         self.handler_timeout = handler_timeout
         self._profile_lock = threading.Lock()
         self._profile_seq = 0
@@ -138,6 +144,8 @@ class MetricsExporter:
                     self._debug_spans()
                 elif path == "/debug/profile":
                     self._debug_profile(query)
+                elif path == "/debug/flight":
+                    self._debug_flight(query)
                 else:
                     self._reply(404, "text/plain", b"not found\n")
 
@@ -163,6 +171,23 @@ class MetricsExporter:
                 body = json.dumps({"spans": spans,
                                    "count": len(spans)}).encode()
                 self._reply(200, "application/json", body)
+
+            def _debug_flight(self, query: str) -> None:
+                if exporter.flight is None:
+                    self._reply(404, "application/json", json.dumps(
+                        {"error": "no flight recorder configured "
+                                  "(pass flight= to MetricsExporter)"}
+                        ).encode())
+                    return
+                fr = exporter.flight
+                records = fr.snapshot()
+                out = {"enabled": fr.enabled, "count": len(records),
+                       "records": records}
+                params = urllib.parse.parse_qs(query)
+                if params.get("dump", ["0"])[0] not in ("0", ""):
+                    out["dump_path"] = fr.dump("on_demand")
+                self._reply(200, "application/json",
+                            json.dumps(out, default=repr).encode())
 
             def _debug_profile(self, query: str) -> None:
                 if exporter.profile_dir is None:

@@ -5,9 +5,16 @@ The workflow the README documents::
     # capture: run with --trace --metrics-path (or scrape /debug/spans),
     # one JSONL file per rank
     graftscope steps rank0.jsonl rank1.jsonl ...   # straggler attribution
-    graftscope requests serve.jsonl                # request lifecycles
+    graftscope requests 'logs/replica-*.jsonl'     # stitched lifecycles
     graftscope export-perfetto *.jsonl -o trace.json   # → ui.perfetto.dev
     graftscope fleet host1:9090 host2:9090         # live fleet health/SLO
+    graftscope postmortem flight-*.jsonl           # who held what at death
+
+Log arguments are shell-style globs as well as literal paths (quote them
+to stop your shell expanding first; useful over ssh). Feeding
+``requests`` every replica's log at once is the point: a request that
+migrated across a breaker trip appears once per replica under one
+``trace_id``, and the stitched view reassembles the journey.
 
 Stdlib-only (no jax): runs on a laptop against scp'd logs (``fleet``
 scrapes live ``/metrics`` endpoints instead). All the offline analysis
@@ -16,10 +23,28 @@ lives in :mod:`telemetry.timeline`; this module is formatting.
 from __future__ import annotations
 
 import argparse
+import glob as _glob
 import json
 import sys
 
+from k8s_distributed_deeplearning_tpu.telemetry import flight as flight_mod
 from k8s_distributed_deeplearning_tpu.telemetry import timeline
+
+
+def _expand_logs(patterns: list[str]) -> list[str]:
+    """Expand each argument as a glob (recursive ``**`` allowed), keeping
+    first-seen order and deduping. A pattern matching nothing passes
+    through literally so ``open()`` raises the honest FileNotFoundError
+    instead of the tool silently analyzing fewer logs than asked."""
+    out: list[str] = []
+    seen: set[str] = set()
+    for pat in patterns:
+        matches = sorted(_glob.glob(pat, recursive=True)) or [pat]
+        for m in matches:
+            if m not in seen:
+                seen.add(m)
+                out.append(m)
+    return out
 
 
 def _fmt_ms(v: float | None) -> str:
@@ -27,7 +52,7 @@ def _fmt_ms(v: float | None) -> str:
 
 
 def _cmd_steps(args: argparse.Namespace) -> int:
-    parsed = timeline.parse_files(args.logs)
+    parsed = timeline.parse_files(_expand_logs(args.logs))
     if parsed.skipped:
         print(f"note: skipped {parsed.skipped} unparseable line(s) "
               f"of {parsed.total_lines} (torn writes from killed ranks?)",
@@ -75,21 +100,37 @@ def _cmd_steps(args: argparse.Namespace) -> int:
     return 0
 
 
+def _stitched_json(sr: "timeline.StitchedRequest") -> dict:
+    return {"trace_id": sr.trace_id, "tenant": sr.tenant,
+            "migrations": sr.migrations, "replicas": sr.replicas,
+            "request_ids": sr.request_ids,
+            "finish_reason": sr.finish_reason,
+            "total_latency_ms": sr.total_latency_ms,
+            "total_new_tokens": sr.total_new_tokens,
+            "hops": sr.hops}
+
+
 def _cmd_requests(args: argparse.Namespace) -> int:
-    parsed = timeline.parse_files(args.logs)
+    parsed = timeline.parse_files(_expand_logs(args.logs))
     if parsed.skipped:
         print(f"note: skipped {parsed.skipped} unparseable line(s)",
               file=sys.stderr)
     summary = timeline.requests_summary(parsed)
+    stitched = timeline.stitch_requests(parsed)
+    migrated = [sr for sr in stitched if sr.migrations]
     if args.json:
-        json.dump(summary, sys.stdout, indent=2)
+        json.dump({**summary,
+                   "journeys": len(stitched),
+                   "migrated": [_stitched_json(sr) for sr in migrated]},
+                  sys.stdout, indent=2)
         print()
         return 0
     if not summary["requests"]:
         print("no request_trace events found — was the engine run with "
               "request_trace_sample > 0?")
         return 1
-    print(f"{summary['requests']} sampled request trace(s)")
+    print(f"{summary['requests']} sampled request trace(s), "
+          f"{len(stitched)} journey(s), {len(migrated)} migrated")
     for tenant, t in summary["tenants"].items():
         print(f"\ntenant {tenant} ({t['requests']} requests):")
         print(f"  queue   p50 {_fmt_ms(t['queue_p50_ms'])} ms   "
@@ -100,11 +141,29 @@ def _cmd_requests(args: argparse.Namespace) -> int:
               f"tokens/s p50 {t['tokens_per_s_p50']}")
         print(f"  prefill chunks (mean): {t['mean_prefill_chunks']}   "
               f"finish: {t['finish_reasons']}")
+    if migrated:
+        print("\nmigrated requests (hops stitched on trace_id):")
+        for sr in migrated:
+            print(f"\n  {sr.trace_id}  tenant {sr.tenant}  "
+                  f"{sr.migrations} migration(s)  "
+                  f"{sr.total_latency_ms:.1f} ms total  "
+                  f"finish: {sr.finish_reason}")
+            for j, hop in enumerate(sr.hops):
+                phase = ("queue" if not (j and hop.get("migrated_from"))
+                         else "migration")
+                arrow = ("  " if not j
+                         else f"  -> (from {hop.get('migrated_from')}) ")
+                print(f"  {arrow}hop {j}: {hop.get('replica')}  "
+                      f"req {hop.get('request_id')}  "
+                      f"{phase} {_fmt_ms(hop.get('queue_ms')).strip()} ms  "
+                      f"ttft {_fmt_ms(hop.get('ttft_ms')).strip()} ms  "
+                      f"total {_fmt_ms(hop.get('latency_ms')).strip()} ms  "
+                      f"+{hop.get('new_tokens', 0)} tok")
     return 0
 
 
 def _cmd_export_perfetto(args: argparse.Namespace) -> int:
-    parsed = timeline.parse_files(args.logs)
+    parsed = timeline.parse_files(_expand_logs(args.logs))
     if parsed.skipped:
         print(f"note: skipped {parsed.skipped} unparseable line(s)",
               file=sys.stderr)
@@ -187,6 +246,85 @@ def _cmd_fleet(args: argparse.Namespace) -> int:
     return 0
 
 
+def _render_postmortem(path: str, header: dict, records: list[dict],
+                       tail: int) -> None:
+    print(f"flight dump {path}")
+    print(f"  reason: {header.get('reason')}   job: {header.get('job')}   "
+          f"records: {header.get('records')}   "
+          f"dumped at t+{header.get('dumped_at_s')}s")
+    if header.get("replica") is not None:
+        print(f"  replica: {header['replica']}")
+    if header.get("trip_error") is not None:
+        print(f"  trip error: {header['trip_error']}")
+    if header.get("site") is not None:
+        print(f"  injected fault: site {header['site']!r} "
+              f"action {header.get('action')!r}")
+    breakers = header.get("breakers")
+    if breakers:
+        opens = [r for r, s in breakers.items() if s != "closed"]
+        print(f"  breakers: " + "  ".join(
+            f"{r}={s}" for r, s in sorted(breakers.items())))
+        if opens:
+            print(f"  NOT CLOSED at death: {', '.join(sorted(opens))}")
+    pool = header.get("pool")
+    if pool:
+        print(f"  kv pool: {pool.get('pages_used')}/"
+              f"{pool.get('pages_total')} pages used, "
+              f"{pool.get('pages_shared')} shared, "
+              f"{pool.get('pages_reserved', pool.get('reserved'))} reserved")
+    by_owner = header.get("pages_by_owner")
+    if by_owner:
+        print("  pages held at death, by owner:")
+        for owner, n in sorted(by_owner.items(), key=lambda kv: -kv[1]):
+            print(f"    {owner:<10} {n}")
+    held = header.get("pages_held")
+    if held:
+        for owner, pages in sorted(held.items()):
+            if not pages:
+                continue
+            shown = ", ".join(str(p) for p in pages[:16])
+            more = f" ... +{len(pages) - 16} more" if len(pages) > 16 else ""
+            print(f"    {owner}: [{shown}{more}]")
+    leak = header.get("leak")
+    if leak:
+        print(f"  LEAK ({leak.get('origin')}): "
+              f"{leak.get('pages_leaked')} page(s) never returned, "
+              f"by owner {leak.get('by_owner')}")
+    if records and tail:
+        print(f"\n  last {min(tail, len(records))} of {len(records)} "
+              f"ring record(s):")
+        for rec in records[-tail:]:
+            src = rec.get("source", "?")
+            rest = {k: v for k, v in rec.items()
+                    if k not in ("source", "t_s")}
+            print(f"    t+{rec.get('t_s')}s [{src}] "
+                  + json.dumps(rest, default=str))
+
+
+def _cmd_postmortem(args: argparse.Namespace) -> int:
+    paths = _expand_logs(args.dumps)
+    rc = 0
+    out_json = []
+    for i, path in enumerate(paths):
+        try:
+            header, records = flight_mod.load_dump(path)
+        except (OSError, ValueError) as e:
+            print(f"{path}: not a flight dump: {e}", file=sys.stderr)
+            rc = 1
+            continue
+        if args.json:
+            out_json.append({"path": path, "header": header,
+                             "records": records})
+            continue
+        if i:
+            print()
+        _render_postmortem(path, header, records, args.tail)
+    if args.json:
+        json.dump(out_json, sys.stdout, indent=2, default=str)
+        print()
+    return rc
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(
         prog="graftscope",
@@ -215,8 +353,11 @@ def main(argv: list[str] | None = None) -> int:
 
     p = sub.add_parser(
         "requests", help="group sampled request_trace lifecycle events "
-                         "by tenant")
-    p.add_argument("logs", nargs="+")
+                         "by tenant, and stitch migrated requests' "
+                         "per-replica hops into one journey via trace_id")
+    p.add_argument("logs", nargs="+",
+                   help="JSONL files or globs — pass every replica's log "
+                        "to stitch cross-replica migrations")
     p.add_argument("--json", action="store_true")
     p.set_defaults(fn=_cmd_requests)
 
@@ -254,6 +395,19 @@ def main(argv: list[str] | None = None) -> int:
                         "replica is marked down (health 0)")
     p.add_argument("--json", action="store_true")
     p.set_defaults(fn=_cmd_fleet)
+
+    p = sub.add_parser(
+        "postmortem",
+        help="render a flight-recorder dump: why it dumped, breaker "
+             "states, KV pages held at death by owner, and the last ring "
+             "snapshots")
+    p.add_argument("dumps", nargs="+",
+                   help="flight-*.jsonl dump files or globs")
+    p.add_argument("--tail", type=int, default=5,
+                   help="how many trailing ring records to print "
+                        "(default 5; 0 for none)")
+    p.add_argument("--json", action="store_true")
+    p.set_defaults(fn=_cmd_postmortem)
 
     args = ap.parse_args(argv)
     return args.fn(args)

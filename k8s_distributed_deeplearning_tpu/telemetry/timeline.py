@@ -36,9 +36,10 @@ from typing import Any, Iterable
 
 __all__ = [
     "Span", "ParsedLog", "StepRecord", "StepAttribution",
+    "StitchedRequest",
     "parse_lines", "parse_files", "build_step_timelines",
     "attribute_stragglers", "critical_path", "straggler_summary",
-    "requests_summary", "to_perfetto",
+    "requests_summary", "stitch_requests", "to_perfetto",
 ]
 
 # The span that anchors a training step: one per step per rank, so its
@@ -377,6 +378,100 @@ def requests_summary(parsed: ParsedLog) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# Cross-replica request stitching
+
+
+@dataclasses.dataclass
+class StitchedRequest:
+    """One logical request's journey across however many replicas served
+    it, stitched on ``trace_id`` (which ``Request.resume_from_tokens``
+    preserves across a migration while ``request_id`` changes).
+
+    ``hops`` holds the raw ``request_trace`` dicts in journey order: hop
+    0 is where the request first ran; each later hop is the survivor a
+    breaker-trip migration landed it on. Replica clocks are unrelated
+    (per-logger monotonic), so the stitched view is *logical* — hop
+    durations are each replica's own measurement, never cross-replica
+    wall deltas."""
+    trace_id: str
+    hops: list[dict]
+
+    @property
+    def tenant(self) -> str:
+        return str(self.hops[-1].get("tenant", "default"))
+
+    @property
+    def migrations(self) -> int:
+        return len(self.hops) - 1
+
+    @property
+    def replicas(self) -> list[str]:
+        return [str(h.get("replica")) for h in self.hops]
+
+    @property
+    def request_ids(self) -> list[str]:
+        return [str(h.get("request_id")) for h in self.hops]
+
+    @property
+    def finish_reason(self) -> str:
+        return str(self.hops[-1].get("finish_reason"))
+
+    @property
+    def total_latency_ms(self) -> float:
+        return round(sum(float(h.get("latency_ms") or 0.0)
+                         for h in self.hops), 3)
+
+    @property
+    def total_new_tokens(self) -> int:
+        return sum(int(h.get("new_tokens") or 0) for h in self.hops)
+
+
+def _chain_hops(recs: list[dict]) -> list[dict]:
+    """Order one trace's records into the migration chain: the root is
+    the record with no ``migrated_from``; each successor is the record
+    whose ``migrated_from`` names the previous hop's replica. Records the
+    chain cannot place (lost dump, torn log) append in input order —
+    better a complete-but-loosely-ordered journey than a dropped hop."""
+    if len(recs) <= 1:
+        return list(recs)
+    remaining = list(recs)
+    roots = [r for r in remaining if not r.get("migrated_from")]
+    cur = roots[0] if roots else remaining[0]
+    ordered = [cur]
+    remaining.remove(cur)
+    while remaining:
+        nxt = next((r for r in remaining
+                    if r.get("migrated_from") is not None
+                    and r.get("migrated_from") == ordered[-1].get("replica")),
+                   None)
+        if nxt is None:
+            ordered.extend(remaining)
+            break
+        ordered.append(nxt)
+        remaining.remove(nxt)
+    return ordered
+
+
+def stitch_requests(parsed: ParsedLog) -> list[StitchedRequest]:
+    """Group ``request_trace`` events into per-journey
+    :class:`StitchedRequest` records, keyed on ``trace_id``.
+
+    Events from logs predating the trace-id stamp fall back to
+    ``request_id`` as the group key — they still render, they just can't
+    stitch across a migration (the survivor mints a new request_id).
+    First-seen order is preserved so output is stable across runs."""
+    groups: dict[str, list[dict]] = {}
+    order: list[str] = []
+    for r in parsed.requests:
+        key = str(r.get("trace_id") or r.get("request_id") or "?")
+        if key not in groups:
+            order.append(key)
+        groups.setdefault(key, []).append(r)
+    return [StitchedRequest(trace_id=key, hops=_chain_hops(groups[key]))
+            for key in order]
+
+
+# ---------------------------------------------------------------------------
 # Perfetto / Chrome trace_event export
 
 
@@ -436,35 +531,53 @@ def to_perfetto(parsed: ParsedLog, anchor: str = ANCHOR_SPAN) -> dict:
         req_pid = (max(parsed.ranks()) + 1) if parsed.spans else 0
         events.append({"ph": "M", "name": "process_name", "pid": req_pid,
                        "tid": 0, "args": {"name": "requests"}})
-        for i, r in enumerate(parsed.requests):
+        # One thread per stitched journey: a migrated request's hops lay
+        # back-to-back on one track instead of scattering across tracks
+        # with unrelated replica clocks. Hop 0 anchors the track at its
+        # own reconstructed start; each later hop starts where the
+        # previous ended — its queue phase renders as "migration" (the
+        # window between the gateway's resubmit and the survivor's
+        # admission, which is exactly what the survivor's queue_ms
+        # measures for a resumed request).
+        for i, sr in enumerate(stitch_requests(parsed)):
             tid = i + 1
-            rid = str(r.get("request_id", f"req-{i}"))
+            label = (sr.trace_id if sr.migrations
+                     else str(sr.hops[0].get("request_id", sr.trace_id)))
             events.append({"ph": "M", "name": "thread_name", "pid": req_pid,
-                           "tid": tid, "args": {"name": rid}})
-            try:
-                end_s = float(r["elapsed_s"])
-                latency_ms = float(r.get("latency_ms") or 0.0)
-            except (KeyError, TypeError, ValueError):
-                continue
-            t0 = (end_s - latency_ms / 1e3) * 1e6
-            events.append({"ph": "X", "name": rid, "cat": "request",
-                           "pid": req_pid, "tid": tid,
-                           "ts": round(t0, 3),
-                           "dur": round(latency_ms * 1e3, 3),
-                           "args": {k: v for k, v in r.items()
-                                    if k not in ("event", "job")}})
-            # Child slices: queue → prefill (to first token) → decode.
-            queue_us = float(r.get("queue_ms") or 0.0) * 1e3
-            ttft_us = float(r.get("ttft_ms") or 0.0) * 1e3
-            dur_us = latency_ms * 1e3
-            phases = [("queue", 0.0, queue_us),
-                      ("prefill", queue_us, max(ttft_us, queue_us)),
-                      ("decode", max(ttft_us, queue_us), dur_us)]
-            for name, lo, hi in phases:
-                if hi > lo:
-                    events.append({"ph": "X", "name": name,
-                                   "cat": "request_phase",
-                                   "pid": req_pid, "tid": tid,
-                                   "ts": round(t0 + lo, 3),
-                                   "dur": round(hi - lo, 3), "args": {}})
+                           "tid": tid, "args": {"name": label}})
+            cursor: float | None = None     # track-local cursor, us
+            for j, r in enumerate(sr.hops):
+                try:
+                    end_s = float(r["elapsed_s"])
+                    latency_ms = float(r.get("latency_ms") or 0.0)
+                except (KeyError, TypeError, ValueError):
+                    continue
+                dur_us = latency_ms * 1e3
+                t0 = ((end_s - latency_ms / 1e3) * 1e6 if cursor is None
+                      else cursor)
+                cursor = t0 + dur_us
+                rid = str(r.get("request_id", f"req-{i}"))
+                name = (f"{rid} @ {r.get('replica')}" if sr.migrations
+                        else rid)
+                events.append({"ph": "X", "name": name, "cat": "request",
+                               "pid": req_pid, "tid": tid,
+                               "ts": round(t0, 3),
+                               "dur": round(dur_us, 3),
+                               "args": {k: v for k, v in r.items()
+                                        if k not in ("event", "job")}})
+                # Child slices: queue/migration → prefill → decode.
+                queue_us = float(r.get("queue_ms") or 0.0) * 1e3
+                ttft_us = float(r.get("ttft_ms") or 0.0) * 1e3
+                first = ("queue" if not (j and r.get("migrated_from"))
+                         else "migration")
+                phases = [(first, 0.0, queue_us),
+                          ("prefill", queue_us, max(ttft_us, queue_us)),
+                          ("decode", max(ttft_us, queue_us), dur_us)]
+                for pname, lo, hi in phases:
+                    if hi > lo:
+                        events.append({"ph": "X", "name": pname,
+                                       "cat": "request_phase",
+                                       "pid": req_pid, "tid": tid,
+                                       "ts": round(t0 + lo, 3),
+                                       "dur": round(hi - lo, 3), "args": {}})
     return {"traceEvents": events, "displayTimeUnit": "ms"}

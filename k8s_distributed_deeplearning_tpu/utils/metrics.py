@@ -127,6 +127,10 @@ class ServingStats:
         self.kv_pages_total = 0
         self.kv_pages_used = 0
         self.kv_pages_shared = 0
+        # Page-ledger attribution: owner class -> live pages (slot/trie/
+        # draft + the reservation headroom). Feeds the per-owner gauge
+        # family and the flight recorder's pool snapshot.
+        self.kv_pages_by_owner: dict[str, int] = {}
         # Failover gateway (serve/gateway.py): request dispatches to a
         # replica, in-flight migrations off sick/draining replicas,
         # speculative hedge dispatches, and circuit-breaker trips.
@@ -207,13 +211,18 @@ class ServingStats:
         self.request_traces += 1
 
     def record_kv_pool(self, pages_total: int, pages_used: int,
-                       pages_shared: int) -> None:
+                       pages_shared: int,
+                       by_owner: dict | None = None) -> None:
         """Latest paged-KV pool utilization snapshot. Deliberately NO
         ``_tick()``: a gauge refresh is not serving activity and must not
-        stretch the elapsed window the throughput rates divide by."""
+        stretch the elapsed window the throughput rates divide by.
+        ``by_owner`` carries the page ledger's owner attribution
+        (slot/trie/draft/reserved); None leaves the last value in place."""
         self.kv_pages_total = int(pages_total)
         self.kv_pages_used = int(pages_used)
         self.kv_pages_shared = int(pages_shared)
+        if by_owner is not None:
+            self.kv_pages_by_owner = {k: int(v) for k, v in by_owner.items()}
 
     def record_gateway_dispatch(self) -> None:
         """One gateway request dispatch (first placement, a migration
@@ -285,6 +294,7 @@ class ServingStats:
             "kv_pages_total": self.kv_pages_total,
             "kv_pages_used": self.kv_pages_used,
             "kv_pages_shared": self.kv_pages_shared,
+            "kv_pages_by_owner": dict(self.kv_pages_by_owner),
             "request_traces_sampled": self.request_traces,
             "gateway_dispatches": self.gateway_dispatches,
             "gateway_migrations": self.gateway_migrations,

@@ -65,9 +65,13 @@ CHUNKS[gateway]="tests/test_gateway.py"
 # attention kernel (interpret mode on CPU): both compile their own draft/
 # target engines, so they get their own chunk.
 CHUNKS[spec]="tests/test_spec.py tests/test_pallas_paged_attn.py"
+# graftflight (flight recorder / page ledger / trace stitching): mostly
+# jax-free unit tests plus engine+gateway chaos cases that compile their
+# own tiny models — its own chunk so serve/gateway stay under timeout.
+CHUNKS[flight]="tests/test_flight.py"
 CHUNKS[slow1]="tests/test_train_e2e.py tests/test_multiprocess.py"
 CHUNKS[slow2]="tests/test_multihost_train.py tests/test_multihost_llama.py tests/test_train_zoo.py"
-ORDER=(lint core parallel1 parallel2 moe train llama deploy serve sched paged faults graftscope fleet gateway spec slow1 slow2)
+ORDER=(lint core parallel1 parallel2 moe train llama deploy serve sched paged faults graftscope fleet gateway spec flight slow1 slow2)
 
 # --- completeness check: every tests/test_*.py in EXACTLY one chunk ------
 # ...and every declared chunk actually in ORDER: a chunk missing from the

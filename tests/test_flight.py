@@ -1,0 +1,764 @@
+"""graftflight: the black-box flight recorder, the KV page ledger, and
+cross-replica trace stitching.
+
+Three planes under test:
+
+- **PagePool owner ledger** (``serve/page_pool.py``): every live page
+  carries exactly one owner tag (slot/trie/draft; scratch pinned), pure
+  attribution on top of the refcounts — ``owners_summary()`` feeds the
+  ``serve_kv_pages_by_owner`` gauge and flight dumps.
+- **FlightRecorder** (``telemetry/flight.py``): bounded snapshot ring,
+  JSONL dumps on every terminal path (breaker trip, drain, injected
+  fault, on demand), ``graftscope postmortem`` round-trip, and the
+  drain/shutdown leak guard's registry-checked ``kv_page_leak`` event.
+- **Trace stitching** (``telemetry/timeline.py`` + graftscope): a
+  migrated request's per-replica ``request_trace`` hops share one
+  ``trace_id`` (survives ``resume_from_tokens``) and reassemble into a
+  single journey across log files.
+
+jax-free tests run first; the engine/gateway integration cases compile
+their own tiny model (module-scoped fixture).
+"""
+from __future__ import annotations
+
+import contextlib
+import io
+import json
+import os
+import re
+import urllib.request
+
+import numpy as np
+import pytest
+
+from k8s_distributed_deeplearning_tpu import faults
+from k8s_distributed_deeplearning_tpu.faults.plan import Fault, FaultPlan
+from k8s_distributed_deeplearning_tpu.serve.page_pool import (OWNERS,
+                                                              PagePool)
+from k8s_distributed_deeplearning_tpu.serve.request import Request
+from k8s_distributed_deeplearning_tpu.telemetry import graftscope, timeline
+from k8s_distributed_deeplearning_tpu.telemetry.flight import (FlightRecorder,
+                                                               load_dump)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class _Events:
+    def __init__(self):
+        self.events = []
+
+    def emit(self, event, **fields):
+        self.events.append((event, fields))
+
+    def names(self):
+        return [e for e, _ in self.events]
+
+    def fields(self, name):
+        return [f for e, f in self.events if e == name]
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_plan():
+    faults.deactivate()
+    yield
+    faults.deactivate()
+
+
+# --------------------------------------------------- PagePool owner ledger
+
+
+class TestPageLedger:
+    def test_alloc_tags_default_slot(self):
+        pool = PagePool(8, 4)
+        pages = pool.alloc(3)
+        assert pool.owners_summary() == {"slot": 3, "trie": 0, "draft": 0,
+                                         "reserved": 0}
+        for p in pages:
+            assert pool.refcount(p) == 1
+
+    def test_alloc_with_owner_class(self):
+        pool = PagePool(8, 4)
+        pool.alloc(2, owner="trie")
+        pool.alloc(1, owner="draft")
+        summ = pool.owners_summary()
+        assert summ["trie"] == 2 and summ["draft"] == 1
+
+    def test_deref_to_zero_clears_owner(self):
+        pool = PagePool(8, 4)
+        (p,) = pool.alloc(1)
+        pool.deref(p)
+        assert pool.owners_summary() == {"slot": 0, "trie": 0, "draft": 0,
+                                         "reserved": 0}
+        assert pool.refcount(p) == 0
+
+    def test_shared_page_keeps_one_tag(self):
+        # A page both a slot and the trie reference carries ONE tag
+        # (attribution, not accounting): the trie's, retagged on adopt.
+        pool = PagePool(8, 4)
+        (p,) = pool.alloc(1)
+        pool.ref(p)
+        pool.tag(p, "trie")
+        assert pool.owners_summary()["trie"] == 1
+        assert pool.owners_summary()["slot"] == 0
+        pool.deref(p)
+        pool.tag(p, "slot")          # trie evicted, slot still holds it
+        assert pool.owners_summary()["slot"] == 1
+
+    def test_tag_dead_or_scratch_page_rejected(self):
+        pool = PagePool(8, 4)
+        with pytest.raises(RuntimeError):
+            pool.tag(3, "slot")              # never allocated
+        with pytest.raises(RuntimeError):
+            pool.tag(0, "slot")              # scratch is pinned
+        (p,) = pool.alloc(1)
+        with pytest.raises(KeyError):
+            pool.tag(p, "nonsense")
+
+    def test_reserved_is_a_pseudo_owner(self):
+        pool = PagePool(16, 4)
+        pool.alloc(2)
+        pool.reserve(4)
+        summ = pool.owners_summary()
+        assert summ["slot"] == 2
+        assert summ["reserved"] == pool.reserved == 4
+        pool.alloc_reserved(1)               # growth claims a promised page
+        summ = pool.owners_summary()
+        assert summ["slot"] == 3 and summ["reserved"] == 3
+
+    def test_held_pages_lists_live_ids(self):
+        pool = PagePool(8, 4)
+        a = pool.alloc(2)
+        b = pool.alloc(1, owner="trie")
+        held = pool.held_pages()
+        assert sorted(held["slot"]) == sorted(a)
+        assert held["trie"] == list(b)
+        assert "free" not in held
+
+    def test_owner_vocabulary(self):
+        assert OWNERS == ("free", "slot", "trie", "draft", "scratch")
+
+
+# --------------------------------------------------- FlightRecorder
+
+
+class TestFlightRecorder:
+    def test_disabled_ring_records_nothing(self):
+        fr = FlightRecorder(0)
+        assert not fr.enabled
+        fr.record("engine", step=1)
+        assert fr.snapshot() == []
+
+    def test_ring_is_bounded_and_stamped(self):
+        fr = FlightRecorder(4)
+        for i in range(10):
+            fr.record("engine:r0", step=i)
+        recs = fr.snapshot()
+        assert [r["step"] for r in recs] == [6, 7, 8, 9]
+        assert all(r["source"] == "engine:r0" for r in recs)
+        assert all(r["t_s"] >= 0 for r in recs)
+
+    def test_dump_and_load_round_trip(self, tmp_path):
+        fr = FlightRecorder(8, dump_dir=str(tmp_path), job="r0")
+        for i in range(3):
+            fr.record("engine:r0", step=i)
+        path = fr.dump("breaker_trip", extra={"replica": "r0"})
+        assert path is not None and os.path.exists(path)
+        assert fr.dumps == [path]
+        header, records = load_dump(path)
+        assert header["flight"] == 1
+        assert header["reason"] == "breaker_trip"
+        assert header["job"] == "r0"
+        assert header["replica"] == "r0"
+        assert header["records"] == 3 == len(records)
+        assert [r["step"] for r in records] == [0, 1, 2]
+
+    def test_extra_cannot_clobber_envelope(self, tmp_path):
+        # A caller's extra dict reusing "reason" (the breaker trip's
+        # error text once did) must not break the parse contract.
+        fr = FlightRecorder(2, dump_dir=str(tmp_path))
+        path = fr.dump("drain", extra={"reason": "lies", "records": 999})
+        header, _ = load_dump(path)
+        assert header["reason"] == "drain"
+        assert header["records"] == 0
+
+    def test_dump_without_dir_stays_in_memory(self):
+        fr = FlightRecorder(2)
+        fr.record("engine", step=1)
+        assert fr.dump("sigterm") is None
+        assert fr.dumps == []
+        assert fr.last_dump["header"]["reason"] == "sigterm"
+        assert fr.last_dump["records"][0]["step"] == 1
+
+    def test_dump_never_raises_on_bad_dir(self, tmp_path):
+        blocker = tmp_path / "not-a-dir"
+        blocker.write_text("file where a directory must go")
+        fr = FlightRecorder(2, dump_dir=str(blocker))
+        assert fr.dump("fault") is None       # OSError swallowed
+
+    def test_dump_emits_registry_checked_event(self, tmp_path):
+        ev = _Events()
+        fr = FlightRecorder(2, dump_dir=str(tmp_path), logger=ev)
+        fr.record("engine", step=1)
+        path = fr.dump("on_demand")
+        (f,) = ev.fields("flight_dump")
+        assert f["reason"] == "on_demand"
+        assert f["records"] == 1
+        assert f["path"] == path
+
+    def test_load_dump_rejects_non_dump(self, tmp_path):
+        p = tmp_path / "serve.jsonl"
+        p.write_text('{"event": "serve_request"}\n')
+        with pytest.raises(ValueError):
+            load_dump(str(p))
+        empty = tmp_path / "empty.jsonl"
+        empty.write_text("")
+        with pytest.raises(ValueError):
+            load_dump(str(empty))
+
+
+# --------------------------------------------------- trace stitching (jax-free)
+
+
+def _trace(request_id, trace_id, replica, migrated_from=None, elapsed=10.0,
+           latency=100.0, queue=5.0, ttft=20.0, tokens=4):
+    return {"event": "request_trace", "request_id": request_id,
+            "trace_id": trace_id, "replica": replica,
+            "migrated_from": migrated_from, "tenant": "default",
+            "elapsed_s": elapsed, "latency_ms": latency, "queue_ms": queue,
+            "ttft_ms": ttft, "new_tokens": tokens,
+            "finish_reason": "length"}
+
+
+class TestStitching:
+    def test_groups_by_trace_id_and_chains_hops(self):
+        parsed = timeline.ParsedLog(requests=[
+            _trace("req-0", "tr-0", "r1", migrated_from="r0"),
+            _trace("req-0", "tr-0", "r0"),
+            _trace("req-1", "tr-1", "r1"),
+        ])
+        stitched = timeline.stitch_requests(parsed)
+        assert [s.trace_id for s in stitched] == ["tr-0", "tr-1"]
+        journey = stitched[0]
+        assert journey.replicas == ["r0", "r1"]   # chain order, not input
+        assert journey.migrations == 1
+        assert journey.total_new_tokens == 8
+        assert journey.total_latency_ms == 200.0
+        assert stitched[1].migrations == 0
+
+    def test_falls_back_to_request_id_without_trace_id(self):
+        recs = [_trace("req-7", None, "r0")]
+        del recs[0]["trace_id"]
+        recs[0]["trace_id"] = None
+        parsed = timeline.ParsedLog(requests=recs)
+        (s,) = timeline.stitch_requests(parsed)
+        assert s.trace_id == "req-7"
+
+    def test_three_hop_chain(self):
+        parsed = timeline.ParsedLog(requests=[
+            _trace("r", "t", "r2", migrated_from="r1"),
+            _trace("r", "t", "r0"),
+            _trace("r", "t", "r1", migrated_from="r0"),
+        ])
+        (s,) = timeline.stitch_requests(parsed)
+        assert s.replicas == ["r0", "r1", "r2"]
+        assert s.migrations == 2
+
+    def test_perfetto_migration_phase(self):
+        parsed = timeline.ParsedLog(requests=[
+            _trace("req-0", "tr-0", "r0"),
+            _trace("req-0", "tr-0", "r1", migrated_from="r0",
+                   queue=30.0, ttft=50.0),
+        ])
+        trace = timeline.to_perfetto(parsed)
+        names = [e["name"] for e in trace["traceEvents"]]
+        assert "migration" in names
+        assert names.count("queue") == 1      # only hop 0's queue phase
+        # Both hops share ONE track (pid, tid) — that's the stitching.
+        hops = [e for e in trace["traceEvents"] if e.get("cat") == "request"]
+        assert len(hops) == 2
+        assert {(h["pid"], h["tid"]) for h in hops} == {(hops[0]["pid"],
+                                                         hops[0]["tid"])}
+        # Hop 1 starts exactly where hop 0 ended (back-to-back layout).
+        assert hops[1]["ts"] == pytest.approx(hops[0]["ts"] + hops[0]["dur"])
+
+    def test_graftscope_requests_glob_and_stitch(self, tmp_path):
+        for i, rec in enumerate([_trace("req-0", "tr-0", "r0"),
+                                 _trace("req-0", "tr-0", "r1",
+                                        migrated_from="r0")]):
+            (tmp_path / f"r{i}.jsonl").write_text(json.dumps(rec) + "\n")
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            rc = graftscope.main(["requests", "--json",
+                                  str(tmp_path / "r*.jsonl")])
+        assert rc == 0
+        data = json.loads(buf.getvalue())
+        assert data["journeys"] == 1
+        (sr,) = data["migrated"]
+        assert sr["replicas"] == ["r0", "r1"]
+        assert sr["migrations"] == 1
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            rc = graftscope.main(["requests", str(tmp_path / "r*.jsonl")])
+        assert rc == 0
+        assert "migration" in buf.getvalue()
+
+    def test_glob_expansion_keeps_literal_misses(self, tmp_path):
+        # A pattern matching nothing must surface as FileNotFoundError,
+        # not silently analyze fewer logs than asked.
+        with pytest.raises(FileNotFoundError):
+            graftscope.main(["requests", str(tmp_path / "absent-*.jsonl")])
+
+
+# --------------------------------------------------- postmortem CLI (jax-free)
+
+
+class TestPostmortem:
+    def _dump(self, tmp_path) -> str:
+        fr = FlightRecorder(4, dump_dir=str(tmp_path), job="gw")
+        fr.record("engine:r0", step=1, pool_owners={"slot": 2})
+        return fr.dump("breaker_trip", extra={
+            "replica": "r0",
+            "breakers": {"r0": "open", "r1": "closed"},
+            "pool": {"pages_total": 16, "pages_used": 2,
+                     "pages_shared": 0, "pages_reserved": 0},
+            "pages_by_owner": {"slot": 2, "trie": 0},
+            "pages_held": {"slot": [1, 2]}})
+
+    def test_renders_breakers_and_ledger(self, tmp_path):
+        path = self._dump(tmp_path)
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            assert graftscope.main(["postmortem", path]) == 0
+        text = buf.getvalue()
+        assert "breaker_trip" in text
+        assert "r0=open" in text
+        assert "NOT CLOSED at death: r0" in text
+        assert "slot" in text and "[1, 2]" in text
+
+    def test_json_mode(self, tmp_path):
+        path = self._dump(tmp_path)
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            assert graftscope.main(["postmortem", "--json", path]) == 0
+        (rec,) = json.loads(buf.getvalue())
+        assert rec["header"]["breakers"]["r0"] == "open"
+        assert rec["records"][0]["step"] == 1
+
+    def test_rejects_non_dump(self, tmp_path):
+        p = tmp_path / "serve.jsonl"
+        p.write_text('{"event": "serve_request"}\n')
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            assert graftscope.main(["postmortem", str(p)]) == 1
+
+
+# --------------------------------------------------- exporter + healthz (jax-free)
+
+
+def _get(url: str):
+    with urllib.request.urlopen(url, timeout=5) as r:
+        return r.status, json.loads(r.read())
+
+
+class TestExporterSurface:
+    def test_debug_flight_endpoint(self, tmp_path):
+        from k8s_distributed_deeplearning_tpu.telemetry.exporter import (
+            MetricsExporter)
+        from k8s_distributed_deeplearning_tpu.telemetry.registry import (
+            MetricsRegistry)
+        fr = FlightRecorder(4, dump_dir=str(tmp_path))
+        fr.record("engine", step=3)
+        ex = MetricsExporter(MetricsRegistry(), port=0, flight=fr).start()
+        try:
+            status, body = _get(
+                f"http://127.0.0.1:{ex.port}/debug/flight")
+            assert status == 200
+            assert body["enabled"] and body["count"] == 1
+            assert body["records"][0]["step"] == 3
+            assert "dump_path" not in body
+            status, body = _get(
+                f"http://127.0.0.1:{ex.port}/debug/flight?dump=1")
+            assert body["dump_path"] and os.path.exists(body["dump_path"])
+            header, _ = load_dump(body["dump_path"])
+            assert header["reason"] == "on_demand"
+        finally:
+            ex.stop()
+
+    def test_debug_flight_404_when_unconfigured(self):
+        from k8s_distributed_deeplearning_tpu.telemetry.exporter import (
+            MetricsExporter)
+        from k8s_distributed_deeplearning_tpu.telemetry.registry import (
+            MetricsRegistry)
+        ex = MetricsExporter(MetricsRegistry(), port=0).start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _get(f"http://127.0.0.1:{ex.port}/debug/flight")
+            assert ei.value.code == 404
+        finally:
+            ex.stop()
+
+    def test_healthz_reports_draining_status(self):
+        from k8s_distributed_deeplearning_tpu.serve.cli import _drain_status
+        from k8s_distributed_deeplearning_tpu.telemetry.exporter import (
+            MetricsExporter)
+        from k8s_distributed_deeplearning_tpu.telemetry.registry import (
+            MetricsRegistry)
+
+        class _Eng:
+            draining = False
+            drained = False
+
+        engines = [_Eng(), _Eng()]
+        ex = MetricsExporter(MetricsRegistry(), port=0,
+                             healthz=lambda: _drain_status(engines)).start()
+        try:
+            url = f"http://127.0.0.1:{ex.port}/healthz"
+            assert _get(url)[1]["status"] == "ok"
+            engines[0].draining = True        # drain() called, work held
+            body = _get(url)[1]
+            assert body["status"] == "draining"
+            assert body["draining"] and not body["drained"]
+            for e in engines:
+                e.draining = e.drained = True
+            assert _get(url)[1]["status"] == "drained"
+        finally:
+            ex.stop()
+
+
+# --------------------------------------------------- grafana drift (jax-free)
+
+
+class TestGrafanaDashboardDrift:
+    DASH = os.path.join(REPO, "deploy", "grafana-dashboard.json")
+
+    def _dashboard(self):
+        with open(self.DASH) as f:
+            return json.load(f)
+
+    def test_parses_and_panel_ids_unique(self):
+        panels = self._dashboard()["panels"]
+        ids = [p["id"] for p in panels]
+        assert len(ids) == len(set(ids)), f"duplicate panel ids in {ids}"
+        assert all(isinstance(i, int) for i in ids)
+
+    def test_every_queried_serve_metric_is_exported(self):
+        # Drift guard for the hand-accreted dashboard: every serve_*/
+        # fleet_* series an expr references must be registered by the
+        # bridge (or fleet) source — a renamed gauge otherwise leaves a
+        # silently-empty panel.
+        exported = ""
+        for mod in ("telemetry/bridge.py", "telemetry/fleet.py"):
+            with open(os.path.join(
+                    REPO, "k8s_distributed_deeplearning_tpu", mod)) as f:
+                exported += f.read()
+        missing = []
+        for panel in self._dashboard()["panels"]:
+            for target in panel.get("targets", []):
+                expr = target.get("expr", "")
+                for name in re.findall(
+                        r"\b(?:serve|fleet)_[a-z0-9_]+", expr):
+                    if f'"{name}"' not in exported:
+                        missing.append((panel["id"], name))
+        assert not missing, (
+            f"dashboard queries metrics the bridge never exports: {missing}")
+
+    def test_owner_ledger_panel_present(self):
+        exprs = [t.get("expr", "") for p in self._dashboard()["panels"]
+                 for t in p.get("targets", [])]
+        assert any("serve_kv_pages_by_owner" in e for e in exprs)
+
+
+# --------------------------------------------------- launch plumbing (jax-free)
+
+
+class TestLaunchFlightPlumbing:
+    def _cfg(self, **kw):
+        from k8s_distributed_deeplearning_tpu.config import JobConfig
+        return JobConfig(name="serve-flight", num_workers=1,
+                         tpu_topology="2x4", **kw)
+
+    def _env(self, manifest):
+        c = manifest["spec"]["template"]["spec"]["containers"][0]
+        return {e["name"]: e.get("value") for e in c["env"]}
+
+    def test_render_carries_flight_env(self):
+        from k8s_distributed_deeplearning_tpu.launch.render import (
+            render_tpujob)
+        env = self._env(render_tpujob(self._cfg(flight_ring=256,
+                                                flight_dir="/dumps")))
+        assert env["TPUJOB_FLIGHT_RING"] == "256"
+        assert env["TPUJOB_FLIGHT_DIR"] == "/dumps"
+        env = self._env(render_tpujob(self._cfg()))
+        assert "TPUJOB_FLIGHT_RING" not in env
+        assert "TPUJOB_FLIGHT_DIR" not in env
+
+    def test_validate_accepts_coherent_flight_config(self):
+        from k8s_distributed_deeplearning_tpu.launch import render, validate
+        assert validate.validate(render.render_all(
+            self._cfg(flight_ring=128, flight_dir="/dumps"))) == []
+
+    def test_validate_flags_bad_ring_and_dangling_dir(self):
+        from k8s_distributed_deeplearning_tpu.launch import render, validate
+        docs = render.render_all(self._cfg(flight_ring=64))
+        for doc in docs:
+            if doc["kind"] != "Job":
+                continue
+            for e in doc["spec"]["template"]["spec"]["containers"][0]["env"]:
+                if e["name"] == "TPUJOB_FLIGHT_RING":
+                    e["value"] = "-3"
+        assert any("TPUJOB_FLIGHT_RING" in msg for msg in
+                   validate.validate(docs))
+        dangling = render.render_all(self._cfg(flight_dir="/dumps"))
+        assert any("TPUJOB_FLIGHT_DIR" in msg for msg in
+                   validate.validate(dangling))
+        ring_zero = render.render_all(self._cfg(flight_ring=0,
+                                                flight_dir="/dumps"))
+        assert any("records nothing" in msg for msg in
+                   validate.validate(ring_zero))
+
+
+# --------------------------------------------------- CLI flags (jax-free)
+
+
+class TestCliFlags:
+    def test_flight_dir_requires_ring(self, capsys):
+        from k8s_distributed_deeplearning_tpu.serve import cli
+        with pytest.raises(SystemExit):
+            cli.main(["--flight-dir", "/tmp/x"])
+        assert "--flight-ring" in capsys.readouterr().err
+
+    def test_negative_ring_rejected(self, capsys):
+        from k8s_distributed_deeplearning_tpu.serve import cli
+        with pytest.raises(SystemExit):
+            cli.main(["--flight-ring", "-1"])
+        assert "--flight-ring" in capsys.readouterr().err
+
+
+# --------------------------------------------------- engine integration (jax)
+
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    from k8s_distributed_deeplearning_tpu.models import llama
+    cfg = llama.config_tiny(dtype=jnp.float32, max_seq_len=64)
+    model = llama.LlamaLM(cfg)
+    params = model.init(jax.random.key(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    return model, params, cfg
+
+
+def _requests(cfg, n, seed=0, max_new=6):
+    rng = np.random.default_rng(seed)
+    return [Request(prompt=rng.integers(0, cfg.vocab_size, size=int(
+                rng.integers(4, 17))).astype(np.int32),
+                    max_new_tokens=max_new) for _ in range(n)]
+
+
+class TestEngineFlight:
+    def test_per_step_snapshots(self, tiny, tmp_path):
+        from k8s_distributed_deeplearning_tpu.serve import ServeEngine
+        fr = FlightRecorder(32, dump_dir=str(tmp_path))
+        eng = ServeEngine(*tiny[:2], num_slots=2, flight=fr,
+                          prefix_cache_mb=1.0)
+        eng.run(_requests(tiny[2], 3))
+        recs = fr.snapshot()
+        assert recs
+        rec = recs[-1]
+        assert rec["source"] == "engine:serve"
+        for key in ("step", "queued", "occupied_slots", "pool",
+                    "pool_owners", "last_decode_ms", "draining"):
+            assert key in rec
+        assert set(rec["pool_owners"]) == {"slot", "trie", "draft",
+                                           "reserved"}
+
+    def test_trace_id_survives_resume(self, tiny):
+        (r,) = _requests(tiny[2], 1)
+        resumed = r.resume_from_tokens([1, 2], migrated_from="r0")
+        assert resumed.trace_id == r.trace_id
+        assert resumed.request_id == r.request_id
+        other = _requests(tiny[2], 1)[0]
+        assert other.trace_id != r.trace_id
+
+    def test_drain_dump_fires_once(self, tiny, tmp_path):
+        from k8s_distributed_deeplearning_tpu.serve import ServeEngine
+        ev = _Events()
+        fr = FlightRecorder(32, dump_dir=str(tmp_path), logger=ev)
+        eng = ServeEngine(*tiny[:2], num_slots=2, flight=fr,
+                          request_log=ev)
+        for r in _requests(tiny[2], 2):
+            eng.submit(r)
+        eng.drain()
+        while eng.busy():
+            eng.step()
+        eng.step()                    # quiescent epilogue -> drain dump
+        eng.step()                    # latch: no second dump
+        dumps = [p for p in fr.dumps]
+        assert len(dumps) == 1
+        header, _ = load_dump(dumps[0])
+        assert header["reason"] == "drain"
+        assert sum(header["pages_by_owner"].values()) == 0
+        assert "kv_page_leak" not in ev.names()
+
+    def test_shutdown_leak_guard_clean(self, tiny):
+        from k8s_distributed_deeplearning_tpu.serve import ServeEngine
+        ev = _Events()
+        eng = ServeEngine(*tiny[:2], num_slots=2, request_log=ev,
+                          prefix_cache_mb=1.0, kv_pool_pages=16)
+        for r in _requests(tiny[2], 2):
+            eng.submit(r)
+        eng.step()
+        eng.step()
+        eng.shutdown()                # mid-flight teardown releases all
+        assert eng.pool.counters()["pages_used"] == 0
+        assert "kv_page_leak" not in ev.names()
+
+    def test_leak_guard_emits_on_violation(self, tiny):
+        from k8s_distributed_deeplearning_tpu.serve import ServeEngine
+        ev = _Events()
+        eng = ServeEngine(*tiny[:2], num_slots=2, request_log=ev,
+                          kv_pool_pages=16)
+        eng.run(_requests(tiny[2], 2))
+        eng.pool.alloc(2)             # simulate a lost ref
+        eng.shutdown()
+        (leak,) = ev.fields("kv_page_leak")
+        assert leak["origin"] == "shutdown"
+        assert leak["pages_leaked"] == 2
+        assert leak["by_owner"]["slot"] == 2
+        assert leak["pages_held"]["slot"]
+
+    def test_decode_stall_fault_dumps_black_box(self, tiny, tmp_path):
+        # Satellite 4a: an injected serve_decode stall fires the
+        # last-gasp hook and the dump round-trips through postmortem.
+        from k8s_distributed_deeplearning_tpu.serve import ServeEngine
+        fr = FlightRecorder(32, dump_dir=str(tmp_path))
+        eng = ServeEngine(*tiny[:2], num_slots=2, flight=fr)
+        faults.activate(FaultPlan((Fault(site="serve_decode",
+                                         action="stall", seconds=0.01),)))
+        try:
+            eng.run(_requests(tiny[2], 2))
+        finally:
+            faults.deactivate()
+        fault_dumps = [p for p in fr.dumps
+                       if load_dump(p)[0]["reason"] == "fault"]
+        assert fault_dumps
+        header, records = load_dump(fault_dumps[0])
+        assert header["site"] == "serve_decode"
+        assert header["action"] == "stall"
+        assert "pages_by_owner" in header
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            assert graftscope.main(["postmortem", fault_dumps[0]]) == 0
+        assert "serve_decode" in buf.getvalue()
+
+
+# --------------------------------------------------- gateway chaos (jax)
+
+
+class TestGatewayChaos:
+    def _fleet(self, tiny, tmp_path, n=2):
+        from k8s_distributed_deeplearning_tpu.serve import (ServeEngine,
+                                                            ServeGateway)
+        from k8s_distributed_deeplearning_tpu.utils.metrics import (
+            MetricsLogger, ServingStats)
+        model, params, _ = tiny
+        log_paths = [str(tmp_path / f"r{i}.jsonl") for i in range(n)]
+        streams = [open(p, "w") for p in log_paths]
+        loggers = [MetricsLogger(job="serve", stream=s) for s in streams]
+        fr = FlightRecorder(64, dump_dir=str(tmp_path / "dumps"), job="gw")
+        stats = ServingStats()
+        engines = [ServeEngine(model, params, num_slots=2, eos_id=None,
+                               stats=stats, replica_id=f"r{i}",
+                               request_log=loggers[i],
+                               request_trace_sample=1.0, flight=fr,
+                               prefix_cache_mb=4, kv_pool_pages=16)
+                   for i in range(n)]
+        gw = ServeGateway(engines, stats=stats, failures_to_trip=1,
+                          flight=fr)
+        return gw, engines, fr, loggers, log_paths
+
+    def test_replica_kill_dump_and_stitched_timeline(self, tiny, tmp_path):
+        # THE chaos acceptance case: replica kill mid-decode under the
+        # gateway produces (1) a parseable flight dump naming the open
+        # breaker and the pages held at death by owner class, and (2) a
+        # stitched single-timeline view of the migrated requests across
+        # both replicas via `graftscope requests`.
+        gw, engines, fr, loggers, log_paths = self._fleet(tiny, tmp_path)
+        for r in _requests(tiny[2], 4, seed=5, max_new=12):
+            gw.submit(r)
+        outs = []
+        for _ in range(3):                   # both replicas mid-decode
+            outs.extend(gw.step())
+        assert engines[0].occupied_slots() == 2
+        faults.activate(FaultPlan((Fault(site="gateway_dispatch",
+                                         action="ioerror", step=0,
+                                         attempt=None),)))
+        try:
+            outs.extend(gw.step())           # r0 trips; work migrates
+        finally:
+            faults.deactivate()
+        for _ in range(600):
+            if not gw.busy():
+                break
+            outs.extend(gw.step())
+        assert not gw.busy()
+        for lg in loggers:
+            lg.close()
+
+        # (1) the breaker-trip dump names the open breaker and the
+        # pages r0 held at the moment of death, by owner class.
+        trips = [p for p in fr.dumps
+                 if load_dump(p)[0]["reason"] == "breaker_trip"]
+        assert trips
+        header, records = load_dump(trips[0])
+        assert header["replica"] == "r0"
+        assert header["breakers"]["r0"] == "open"
+        assert header["breakers"]["r1"] == "closed"
+        assert sum(header["pages_by_owner"].values()) > 0
+        assert header["pages_held"]["slot"]
+        assert records                       # the flight path rode along
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            assert graftscope.main(["postmortem", trips[0]]) == 0
+        assert "NOT CLOSED at death: r0" in buf.getvalue()
+
+        # (2) graftscope requests over both replica logs (via glob)
+        # stitches each migrated request into one journey r0 -> r1.
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            assert graftscope.main(
+                ["requests", "--json",
+                 str(tmp_path / "r*.jsonl")]) == 0
+        data = json.loads(buf.getvalue())
+        assert len(data["migrated"]) == 2
+        for sr in data["migrated"]:
+            assert sr["replicas"] == ["r0", "r1"]
+            assert sr["finish_reason"] == "length"
+        # The Perfetto export lays each journey on one track with a
+        # migration phase at the handoff.
+        parsed = timeline.parse_files(log_paths)
+        trace = timeline.to_perfetto(parsed)
+        assert [e for e in trace["traceEvents"]
+                if e["name"] == "migration"]
+
+    def test_gateway_fault_dump_names_site(self, tiny, tmp_path):
+        gw, engines, fr, loggers, _ = self._fleet(tiny, tmp_path)
+        for r in _requests(tiny[2], 2, seed=7, max_new=8):
+            gw.submit(r)
+        faults.activate(FaultPlan((Fault(site="gateway_dispatch",
+                                         action="ioerror", step=0,
+                                         attempt=None),)))
+        try:
+            gw.step()
+        finally:
+            faults.deactivate()
+        for lg in loggers:
+            lg.close()
+        fault_dumps = [p for p in fr.dumps
+                       if load_dump(p)[0]["reason"] == "fault"]
+        assert fault_dumps
+        assert any(load_dump(p)[0]["site"] == "gateway_dispatch"
+                   for p in fault_dumps)
