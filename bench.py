@@ -1190,6 +1190,271 @@ def measure_serve_gateway(n_requests: int = 8, num_slots: int = 8,
     }
 
 
+def measure_serve_transport(n_requests: int = 4, num_slots: int = 4,
+                            out_len: int = 32, overhead_repeats: int = 3,
+                            seed: int = 0) -> dict:
+    """Cross-process replica transport (serve/transport.py): the graftwire
+    robustness claims, measured over real sockets.
+
+    A 2-replica remote fleet (real engines behind in-process
+    ``ReplicaServer`` threads, driven by a ``ServeGateway`` over
+    ``ReplicaClient`` HTTP) serves the workload at 50% fleet load
+    (n_requests == half the fleet's slots) through a chaos matrix:
+
+    1. **Replica-process kill.** Mid-decode, r0's server is torn down
+       while it provably holds a streaming request; poll exhaustion
+       trips the breaker and live work migrates over the wire (re-prefill
+       of prompt+emitted on the survivor). Gates: 0 lost requests,
+       outputs bit-identical to the unfaulted baseline, exactly-once
+       on_finish, and migrated resume TTFT <= 1.5x the baseline's cold
+       prefill — the PR 10 gate preserved across the network boundary.
+    2. **drop / latency / partition.** Each network fault runs the same
+       workload: ``transport_send`` drops (client-side TimeoutError),
+       injected stalls, and a stateful partition window that severs
+       every call until it heals. The client's deadline+full-jitter
+       retry loop and the server's dispatch-key dedup must absorb all
+       three. Gates per fault: 0 lost, bit-identical, exactly-once.
+    3. **The wire costs little when healthy.** The same workload through
+       a 1-replica REMOTE gateway vs a 1-replica in-process gateway,
+       min-of-repeats wall clock. The replica steps autonomously behind
+       the socket, so the wire adds poll round-trips, not decode time.
+       Gate: remote/local wall ratio <= 1.5.
+    """
+    import numpy as np
+
+    from k8s_distributed_deeplearning_tpu import faults
+    from k8s_distributed_deeplearning_tpu.faults.plan import Fault, FaultPlan
+    from k8s_distributed_deeplearning_tpu.serve import (ReplicaClient,
+                                                        ReplicaServer,
+                                                        Request, ServeEngine,
+                                                        ServeGateway)
+    from k8s_distributed_deeplearning_tpu.utils.metrics import ServingStats
+
+    max_seq = 256
+    model, params, cfg, _ = _serve_cpu_model(max_seq)
+    rng = np.random.default_rng(seed)
+    prompts = [rng.integers(0, cfg.vocab_size, size=int(
+        rng.integers(32, 96))).astype(np.int32) for _ in range(n_requests)]
+
+    def requests() -> list[Request]:
+        return [Request(prompt=p, max_new_tokens=out_len) for p in prompts]
+
+    # -- unfaulted single-engine baseline: the parity oracle -------------
+    ServeEngine(model, params, num_slots=2 * num_slots,
+                max_queue=n_requests).run(requests())   # warmup (compiles)
+    # Warm the per-replica slot shapes too: the chaos fleet's engines
+    # batch at num_slots, not 2*num_slots — without this the first cell
+    # pays XLA compile behind the wire and every timing (and the client
+    # timeout budget) reads compile, not transport.
+    ServeEngine(model, params, num_slots=num_slots,
+                max_queue=n_requests).run(requests())
+    base_eng = ServeEngine(model, params, num_slots=2 * num_slots,
+                           max_queue=n_requests)
+    base_reqs = requests()
+    base_outs = {o.request_id: o for o in base_eng.run(base_reqs)}
+    base_tokens = [list(base_outs[r.request_id].tokens) for r in base_reqs]
+    cold_ttft_ms = float(np.median(
+        [o.ttft_s for o in base_outs.values() if o.ttft_s is not None])) * 1e3
+
+    class _MigrationLog:
+        def __init__(self):
+            self.migrated: list[dict] = []
+
+        def emit(self, event, **fields):
+            if event == "gateway_migrated":
+                self.migrated.append(fields)
+
+    def fleet(n: int, stats: ServingStats, **client_kw):
+        engines = [ServeEngine(model, params, num_slots=num_slots,
+                               max_queue=n_requests, replica_id=f"r{i}")
+                   for i in range(n)]
+        # Default registry: real collectors, so routing reads live load
+        # through the same /metrics scrape path the fleet plane uses.
+        servers = [ReplicaServer(e, handler_timeout=120.0).start()
+                   for e in engines]
+        clients = [ReplicaClient(s.address, replica_id=f"r{i}", stats=stats,
+                                 health_refresh_s=0.0, **client_kw)
+                   for i, s in enumerate(servers)]
+        return engines, servers, clients
+
+    def run_chaos(scenario: str) -> dict:
+        """One chaos cell: the full workload through a 2-replica remote
+        gateway under *scenario*; returns loss/parity/exactly-once plus
+        (for the kill) the migrated-resume timings."""
+        stats = ServingStats()
+        log = _MigrationLog()
+        if scenario == "kill":
+            # Short client budget so poll exhaustion trips the breaker
+            # quickly once the server is gone (a dead port refuses
+            # instantly; one cheap retry distinguishes it from a blip).
+            engines, servers, clients = fleet(
+                2, stats, timeout_s=10.0, retries=1, backoff_s=0.01)
+        else:
+            # Generous budget: the retry loop must outlast the fault
+            # window (full-jitter doubling from 0.15s over 6 retries).
+            engines, servers, clients = fleet(
+                2, stats, timeout_s=120.0, retries=6, backoff_s=0.15)
+        gw = ServeGateway(clients, failures_to_trip=1, stats=stats,
+                          logger=log)
+        chaos_reqs = requests()
+        token_times: dict[str, list[float]] = {}
+        finishes: dict[str, int] = {}
+        t_sub: dict[str, float] = {}
+        for r in chaos_reqs:
+            token_times[r.request_id] = []
+            finishes[r.request_id] = 0
+            r.on_token = (lambda t, _rid=r.request_id:
+                          token_times[_rid].append(time.perf_counter()))
+            r.on_finish = (lambda _reason, _rid=r.request_id:
+                           finishes.__setitem__(_rid, finishes[_rid] + 1))
+        plan = {
+            "drop": FaultPlan((Fault(site="transport_send", action="drop",
+                                     count=3),)),
+            "latency": FaultPlan((Fault(site="transport_send",
+                                        action="stall", seconds=0.25,
+                                        count=3),)),
+            "partition": FaultPlan((Fault(site="transport_send",
+                                          action="partition",
+                                          seconds=0.5),)),
+        }.get(scenario)
+        t_kill = None
+        outs: list = []
+        try:
+            # drop/latency are armed before admission so the ambiguous-
+            # submit path (response lost after success) is exercised too;
+            # the partition window opens only once polling is underway,
+            # else admission itself would sit out the whole window.
+            if plan is not None and scenario != "partition":
+                faults.activate(plan)
+            for r in chaos_reqs:
+                t_sub[r.request_id] = time.perf_counter()
+                gw.submit(r)
+            if plan is not None and scenario == "partition":
+                faults.activate(plan)
+            if scenario == "kill":
+                # Kill r0 only once it provably holds a live, already-
+                # streaming request — else there is nothing to migrate.
+                deadline = time.time() + 120.0
+                while True:
+                    outs.extend(gw.step())
+                    live0 = {st.req.request_id
+                             for st in clients[0]._streams.values()}
+                    assert clients[0]._streams, \
+                        "r0 finished before the kill"
+                    if live0 and any(token_times[rid] for rid in live0):
+                        break
+                    assert time.time() < deadline, "no stream to kill"
+                    time.sleep(0.005)
+                # The kill lands when close() returns (port dead, step
+                # thread joined) — the teardown itself is not resume
+                # latency the gateway could have avoided.
+                servers[0].close()
+                t_kill = time.perf_counter()
+            deadline = time.time() + 240.0
+            while len(outs) < n_requests and time.time() < deadline:
+                outs.extend(gw.step())
+                time.sleep(0.005)
+        finally:
+            faults.deactivate()
+            for s in servers:
+                s.close()
+        by_id = {o.request_id: o for o in outs}
+        lost = sum(1 for i, r in enumerate(chaos_reqs)
+                   if finishes[r.request_id] != 1
+                   or by_id.get(r.request_id) is None
+                   or by_id[r.request_id].finish_reason != "length"
+                   or list(by_id[r.request_id].tokens) != base_tokens[i])
+        cell = {"lost": lost,
+                "migrations": stats.gateway_migrations,
+                "breaker_trips": stats.gateway_breaker_trips,
+                "transport_retries": stats.transport_retries,
+                "transport_dedup_hits": stats.transport_dedup_hits}
+        if scenario == "kill":
+            resumes_ms = []
+            for f in log.migrated:
+                post = [t for t in token_times.get(f["request_id"], [])
+                        if t > t_kill]
+                if post:
+                    resumes_ms.append((post[0] - t_kill) * 1e3)
+            cell["migrated_resume_ms"] = (
+                round(float(np.median(resumes_ms)), 3) if resumes_ms
+                else float("nan"))
+            # The like-for-like baseline for a resume OVER THE WIRE is a
+            # cold prefill over the same wire: submit→first-token for
+            # the cell's own pre-kill admissions (wire submit, server
+            # step-loop wakeup, chunked prefill, poll delivery — every
+            # cost the resume also pays). Comparing against the
+            # in-process baseline's TTFT would charge the wire's fixed
+            # round-trip costs to the migration machinery.
+            colds_ms = [(times[0] - t_sub[rid]) * 1e3
+                        for rid, times in token_times.items()
+                        if times and times[0] <= t_kill]
+            cell["wire_cold_ttft_ms"] = (
+                round(float(np.median(colds_ms)), 3) if colds_ms
+                else float("nan"))
+            cell["migrated_resume_ratio"] = (
+                round(cell["migrated_resume_ms"]
+                      / cell["wire_cold_ttft_ms"], 3)
+                if resumes_ms and colds_ms else float("inf"))
+        return cell
+
+    chaos = {s: run_chaos(s)
+             for s in ("kill", "drop", "latency", "partition")}
+
+    # -- healthy-path wire overhead: remote vs in-process, 1 replica -----
+    def run_once(remote: bool) -> float:
+        if not remote:
+            eng = ServeEngine(model, params, num_slots=num_slots,
+                              max_queue=n_requests)
+            gw = ServeGateway([eng])
+            t0 = time.perf_counter()
+            gw.run(requests())
+            return time.perf_counter() - t0
+        stats = ServingStats()
+        engines, servers, clients = fleet(1, stats, timeout_s=120.0,
+                                          backoff_s=0.05)
+        try:
+            gw = ServeGateway(clients)
+            outs: list = []
+            t0 = time.perf_counter()
+            for r in requests():
+                gw.submit(r)
+            deadline = time.time() + 240.0
+            while len(outs) < n_requests and time.time() < deadline:
+                outs.extend(gw.step())
+                time.sleep(0.002)
+            assert len(outs) == n_requests, "remote overhead run incomplete"
+            return time.perf_counter() - t0
+        finally:
+            for s in servers:
+                s.close()
+
+    run_once(False)                          # warmup replays (compiles)
+    run_once(True)
+    walls = {"local": float("inf"), "remote": float("inf")}
+    for _ in range(overhead_repeats):
+        walls["local"] = min(walls["local"], run_once(False))
+        walls["remote"] = min(walls["remote"], run_once(True))
+    wire_ratio = walls["remote"] / walls["local"]
+
+    return {
+        "transport_lost_requests": sum(c["lost"] for c in chaos.values()),
+        "transport_kill_migrations": chaos["kill"]["migrations"],
+        "transport_kill_breaker_trips": chaos["kill"]["breaker_trips"],
+        "transport_migrated_resume_ms": chaos["kill"]["migrated_resume_ms"],
+        "transport_cold_ttft_ms": round(cold_ttft_ms, 3),
+        "transport_migrated_resume_ratio":
+            chaos["kill"]["migrated_resume_ratio"],
+        "transport_wire_wall_ratio": round(wire_ratio, 3),
+        "transport_wall_s_local": round(walls["local"], 3),
+        "transport_wall_s_remote": round(walls["remote"], 3),
+        "transport_chaos": chaos,
+        "transport_config": {"requests": n_requests, "slots": num_slots,
+                             "replicas": 2, "out_len": out_len,
+                             "overhead_repeats": overhead_repeats},
+    }
+
+
 def measure_serve_spec(n_requests: int = 8, num_slots: int = 2,
                        spec_k: int = 7, prompt_range: tuple[int, int] = (32, 96),
                        out_len: int = 73, seed: int = 0) -> dict:
@@ -1936,7 +2201,7 @@ def main() -> None:
     ap.add_argument("--suite",
                     choices=["all", "mnist", "llama", "attention", "zoo",
                              "decode", "moe", "serve", "sched", "gateway",
-                             "spec", "telemetry", "recovery"],
+                             "spec", "telemetry", "recovery", "transport"],
                     default="all")
     ap.add_argument("--cpu-baseline", action="store_true",
                     help="internal: measure the CPU reference stand-in")
@@ -2092,6 +2357,44 @@ def main() -> None:
             gates.append("GATE gateway_routing_overhead_pct: "
                          f"{extra['gateway_routing_overhead_pct']}"
                          " >= 2.0")
+        for g in gates:
+            print(g, file=sys.stderr)
+        if gates:
+            sys.exit(2)
+        return
+    if args.suite == "transport":
+        extra = measure_serve_transport()
+        emit({
+            "metric": "transport_wire_wall_ratio",
+            "value": extra["transport_wire_wall_ratio"],
+            "unit": "x (remote 1-replica gateway wall / in-process)",
+            "vs_baseline": None,
+            "extra": extra})
+        # The ISSUE's absolute gates, independent of the stored baseline:
+        # the chaos matrix (replica kill + drop/latency/partition at 50%
+        # fleet load) must lose nothing and stay bit-identical with
+        # exactly-once on_finish; a migrated request must resume over
+        # the wire within 1.5x a cold prefill (the PR 10 gate preserved
+        # across the network boundary); and the healthy remote path must
+        # stay within 1.5x the in-process gateway's wall clock.
+        gates = []
+        for name, cell in extra["transport_chaos"].items():
+            if cell["lost"] != 0:
+                gates.append(f"GATE transport_{name}_lost: "
+                             f"{cell['lost']} != 0")
+        kill = extra["transport_chaos"]["kill"]
+        if kill["breaker_trips"] < 1 or kill["migrations"] < 1:
+            gates.append("GATE transport_kill: breaker_trips="
+                         f"{kill['breaker_trips']} migrations="
+                         f"{kill['migrations']} — the kill cell never "
+                         "exercised failover")
+        if not extra["transport_migrated_resume_ratio"] <= 1.5:
+            gates.append("GATE transport_migrated_resume_ratio: "
+                         f"{extra['transport_migrated_resume_ratio']}"
+                         " > 1.5")
+        if not extra["transport_wire_wall_ratio"] <= 1.5:
+            gates.append("GATE transport_wire_wall_ratio: "
+                         f"{extra['transport_wire_wall_ratio']} > 1.5")
         for g in gates:
             print(g, file=sys.stderr)
         if gates:

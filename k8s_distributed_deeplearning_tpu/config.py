@@ -148,6 +148,18 @@ class JobConfig:
     # integer domain offline.
     flight_ring: int | None = None
     flight_dir: str | None = None
+    # Remote serving topology (serve/transport.py): when serve_replicas
+    # is set the renderer emits a second tier of roles — an Indexed Job
+    # of replica-server pods (serve/cli.py --replica-server) plus a
+    # single-pod gateway Job that dispatches to them over HTTP
+    # (--replica-endpoints rendered from the replica headless Service's
+    # stable pod DNS). Both roles carry httpGet probes on metrics_port:
+    # liveness /healthz (process up — stays 200 while draining) and
+    # readiness /readyz (flips 503 the moment drain starts, so the
+    # routing layer stops sending NEW work ahead of the handshake).
+    serve_replicas: int | None = None
+    serve_preset: str = "tiny"       # model preset for both serving roles
+    serve_slots: int | None = None   # per-replica decode slots (None = CLI default)
     # preStop sleep: delay SIGTERM by this many seconds so the endpoint/
     # gateway routing layer observes the pod leaving the ready set and
     # stops sending NEW requests before the drain starts (the classic

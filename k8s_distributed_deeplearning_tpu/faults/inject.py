@@ -47,13 +47,20 @@ class FaultInjector:
     """
 
     def __init__(self, plan: FaultPlan, *, rank: int = 0, attempt: int = 0,
-                 sleep: Callable[[float], None] = time.sleep):
+                 sleep: Callable[[float], None] = time.sleep,
+                 clock: Callable[[], float] = time.monotonic):
         plan.validate_or_raise()
         self.plan = plan
         self.rank = rank
         self.attempt = attempt
         self._sleep = sleep
+        self._clock = clock
         self._visits = [0] * len(plan.faults)
+        # Active partition windows: site -> monotonic deadline. A fired
+        # "partition" fault severs its site for the fault's ``seconds`` —
+        # EVERY subsequent fire at that site raises until the window
+        # closes, modelling an outage rather than a per-call blip.
+        self._partition_until: dict[str, float] = {}
         self.fired: list[tuple[str, str]] = []   # (site, action) log
 
     def _applies(self, f: Fault, site: str) -> bool:
@@ -72,6 +79,12 @@ class FaultInjector:
         """Give every matching fault at *site* its chance to fire. *step*
         feeds step-triggered faults; *path* (a checkpoint directory) feeds
         the corrupt/truncate actions."""
+        until = self._partition_until.get(site)
+        if until is not None:
+            if self._clock() < until:
+                raise OSError(f"injected partition at site {site!r} "
+                              f"(rank {self.rank}): link severed")
+            del self._partition_until[site]
         for i, f in enumerate(self.plan.faults):
             if not self._applies(f, site) or f.action == "stop":
                 continue
@@ -111,6 +124,17 @@ class FaultInjector:
         if f.action == "ioerror":
             raise OSError(f"injected transient IO error at site {f.site!r} "
                           f"(rank {self.rank})")
+        if f.action == "drop":
+            # The message vanished on the wire: nobody reports an error,
+            # the caller discovers by deadline. TimeoutError (an OSError
+            # subclass) so transport is_transient predicates retry it.
+            raise TimeoutError(f"injected message drop at site {f.site!r} "
+                               f"(rank {self.rank})")
+        if f.action == "partition":
+            self._partition_until[f.site] = self._clock() + f.seconds
+            raise OSError(f"injected partition at site {f.site!r} "
+                          f"(rank {self.rank}): link severed for "
+                          f"{f.seconds}s")
         if f.action in ("truncate", "corrupt"):
             if path is None:
                 raise ValueError(

@@ -29,6 +29,17 @@ Sites (where the hook points live):
 - ``executor``         the PARENT gang executor (``launch/local_executor``):
                        kills worker *rank* from outside after *seconds* —
                        the kubelet/node-failure emulation
+- ``transport_send``   serving transport (``serve/transport.py``), client
+                       side, before each HTTP call leaves — ``ioerror``/
+                       ``drop`` here mean the request NEVER reached the
+                       replica (retry is unambiguous), ``stall`` is send
+                       latency, ``partition`` makes the link raise for
+                       *seconds*
+- ``transport_recv``   serving transport, replica side, after the handler
+                       ran but before the response is written — ``drop``/
+                       ``ioerror`` here are the AMBIGUOUS failure (request
+                       landed, response lost), the case idempotent submit
+                       exists for; ``stall`` is response latency
 
 Actions (what happens when the trigger matches):
 
@@ -43,6 +54,15 @@ Actions (what happens when the trigger matches):
 - ``corrupt``  flip bytes of that file, size-preserving (bitrot/bad DMA)
 - ``stop``     suppress the hooked side effect from ``step`` onward
                (heartbeat writer goes silent — the zombie-rank mode)
+- ``drop``     raise ``TimeoutError`` — the message vanished on the wire
+               and nobody will say so; the caller finds out by deadline.
+               Distinct from ``ioerror`` (an immediate, honest connection
+               error) because the two teach retry layers different
+               lessons: transport sites only
+- ``partition`` raise ``OSError`` now AND for the next ``seconds`` of
+               wall-clock at this site — a severed link stays severed
+               until it heals, unlike the count-scoped ``ioerror`` blip.
+               Transport sites only; needs ``seconds`` > 0
 """
 from __future__ import annotations
 
@@ -50,9 +70,10 @@ import dataclasses
 import json
 
 SITES = ("step", "data_wait", "shard_read", "checkpoint_saved", "heartbeat",
-         "serve_decode", "gateway_dispatch", "executor")
+         "serve_decode", "gateway_dispatch", "executor", "transport_send",
+         "transport_recv")
 ACTIONS = ("exit", "sigterm", "stall", "ioerror", "truncate", "corrupt",
-           "stop")
+           "stop", "drop", "partition")
 
 # Which actions make sense at which sites — a plan naming a nonsensical
 # pair is a bug in the scenario, not a scenario.
@@ -65,6 +86,8 @@ _SITE_ACTIONS = {
     "serve_decode": ("stall", "exit", "sigterm"),
     "gateway_dispatch": ("ioerror", "stall", "exit", "sigterm"),
     "executor": ("exit", "sigterm"),
+    "transport_send": ("ioerror", "stall", "drop", "partition"),
+    "transport_recv": ("ioerror", "stall", "drop", "partition"),
 }
 
 
@@ -103,6 +126,8 @@ class Fault:
                         f"{self.site!r} (valid: {_SITE_ACTIONS[self.site]})")
         if self.action == "stall" and self.seconds <= 0:
             errs.append("stall needs seconds > 0")
+        if self.action == "partition" and self.seconds <= 0:
+            errs.append("partition needs seconds > 0 (the outage window)")
         if self.site == "executor":
             if self.rank is None:
                 errs.append("executor faults must name a rank (the victim)")

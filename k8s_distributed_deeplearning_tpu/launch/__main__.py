@@ -77,6 +77,20 @@ def main(argv: list[str] | None = None) -> int:
                  "before SIGTERM, letting the routing layer stop sending "
                  "new requests first; must be < the termination grace "
                  "period (validate enforces)")
+        p.add_argument(
+            "--serve-replicas", type=int, default=d.serve_replicas,
+            help="also render the remote-serving tier: a headless Service "
+                 "+ Indexed Job of N replica-server pods and a single-pod "
+                 "gateway Job dispatching to them over HTTP "
+                 "(serve/transport.py), probes split /readyz vs /healthz")
+        p.add_argument(
+            "--serve-preset", default=d.serve_preset,
+            choices=["tiny", "small"],
+            help="model preset the replica-server pods load")
+        p.add_argument(
+            "--serve-slots", type=int, default=d.serve_slots,
+            help="decode slots per serving replica (default: the serve "
+                 "CLI's own default)")
     parsers["render"].add_argument(
         "--apply", action="store_true",
         help="pipe the manifests into kubectl apply -f -")
@@ -121,7 +135,10 @@ def main(argv: list[str] | None = None) -> int:
                     cpu=args.cpu, memory=args.memory,
                     fleet_endpoints=args.fleet_endpoints,
                     termination_grace_s=args.termination_grace_s,
-                    pre_stop_sleep_s=args.pre_stop_sleep_s)
+                    pre_stop_sleep_s=args.pre_stop_sleep_s,
+                    serve_replicas=args.serve_replicas,
+                    serve_preset=args.serve_preset,
+                    serve_slots=args.serve_slots)
     docs = render.render_all(cfg)
     text = render.to_yaml(docs)
 

@@ -166,8 +166,207 @@ def render_tpujob(cfg: JobConfig) -> dict:
     }
 
 
+def _serving_probes(cfg: JobConfig) -> dict:
+    """Probe pair shared by both serving roles. Liveness and readiness are
+    deliberately DIFFERENT endpoints: /healthz stays 200 through a drain
+    (the process is healthy, it is finishing work — restarting it would
+    lose the very requests the drain protects), while /readyz flips 503
+    the moment drain starts so the routing layer stops sending new work
+    before the handshake races it."""
+    return {
+        "readinessProbe": {
+            "httpGet": {"path": "/readyz", "port": cfg.metrics_port},
+            "periodSeconds": 2, "failureThreshold": 1,
+        },
+        "livenessProbe": {
+            "httpGet": {"path": "/healthz", "port": cfg.metrics_port},
+            "periodSeconds": 10, "failureThreshold": 3,
+        },
+    }
+
+
+def _serving_chips(cfg: JobConfig) -> int:
+    # Each serving replica is its own single-host slice: the pod claims
+    # the whole topology's chips (no num_workers split — that divisor
+    # belongs to the training gang, not the serving fleet).
+    if cfg.tpu_chips_per_worker is not None:
+        return cfg.tpu_chips_per_worker
+    chips = 1
+    for d in cfg.tpu_topology.split("x"):
+        chips *= int(d)
+    return chips
+
+
+def _serving_env(cfg: JobConfig) -> list[dict]:
+    env = [
+        {"name": "TPUJOB_NAME", "value": cfg.name},
+        {"name": "TPUJOB_METRICS_PORT", "value": str(cfg.metrics_port)},
+    ]
+    if cfg.fault_plan:
+        env.append({"name": "TPUJOB_FAULT_PLAN", "value": cfg.fault_plan})
+    if cfg.tenants:
+        env.append({"name": "TPUJOB_TENANTS", "value": cfg.tenants})
+    return env
+
+
+def _serving_pod(cfg: JobConfig, *, role: str, container: dict,
+                 subdomain: str) -> dict:
+    tmpl: dict = {
+        "metadata": {
+            "labels": {"app": cfg.name, "role": role},
+            "annotations": {
+                "prometheus.io/scrape": "true",
+                "prometheus.io/port": str(cfg.metrics_port),
+                "prometheus.io/path": "/metrics",
+            },
+        },
+        "spec": {
+            "subdomain": subdomain,
+            "restartPolicy": "OnFailure",
+            **({"terminationGracePeriodSeconds":
+                int(cfg.termination_grace_s)}
+               if cfg.termination_grace_s is not None else {}),
+            "containers": [container],
+        },
+    }
+    if role == "serve-replica":
+        tmpl["spec"]["nodeSelector"] = {
+            "cloud.google.com/gke-tpu-accelerator": cfg.tpu_accelerator,
+            "cloud.google.com/gke-tpu-topology": cfg.tpu_topology,
+        }
+    return tmpl
+
+
+def _serving_job(cfg: JobConfig, *, name: str, role: str, replicas: int,
+                 container: dict, subdomain: str) -> dict:
+    return {
+        "apiVersion": "batch/v1",
+        "kind": "Job",
+        "metadata": {"name": name, "namespace": cfg.namespace,
+                     "labels": {"app": cfg.name, "role": role,
+                                "framework":
+                                "k8s-distributed-deeplearning-tpu"}},
+        "spec": {
+            "completions": replicas,
+            "parallelism": replicas,
+            "completionMode": "Indexed",   # stable per-pod DNS identity
+            "backoffLimit": 3,
+            **({"ttlSecondsAfterFinished": 600}
+               if cfg.clean_pod_policy != "None" else {}),
+            "template": _serving_pod(cfg, role=role, container=container,
+                                     subdomain=subdomain),
+        },
+    }
+
+
+def render_replica_service(cfg: JobConfig) -> dict:
+    """Headless service giving replica-server pods stable DNS — the
+    gateway's ``--replica-endpoints`` list is rendered against these
+    names, so no discovery sidecar is needed in the static topology."""
+    name = f"{cfg.name}-replica"
+    return {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {"name": name, "namespace": cfg.namespace,
+                     "labels": {"app": cfg.name, "role": "serve-replica"}},
+        "spec": {
+            "clusterIP": "None",
+            "selector": {"job-name": name},
+            "ports": [{"name": "metrics", "port": cfg.metrics_port}],
+        },
+    }
+
+
+def render_replica_job(cfg: JobConfig) -> dict:
+    """Replica-server role: one engine per pod behind the transport
+    endpoints (serve/cli.py --replica-server). The completion index is
+    the replica rank, so the command goes through the shell to splice
+    $JOB_COMPLETION_INDEX in."""
+    name = f"{cfg.name}-replica"
+    serve = (f"exec python -m k8s_distributed_deeplearning_tpu.launch serve"
+             f" --replica-server --preset {cfg.serve_preset}"
+             f" --metrics-port {cfg.metrics_port}"
+             f" --replica-rank ${{JOB_COMPLETION_INDEX}}"
+             f" --advertise-host $(hostname -f)")
+    if cfg.serve_slots is not None:
+        serve += f" --slots {cfg.serve_slots}"
+    if cfg.tenants:
+        serve += f" --tenants '{cfg.tenants}'"
+    if cfg.flight_ring is not None:
+        serve += f" --flight-ring {cfg.flight_ring}"
+    if cfg.flight_dir is not None:
+        serve += f" --flight-dir {cfg.flight_dir}"
+    container = {
+        "name": "replica",
+        "image": cfg.image,
+        "command": ["/bin/sh", "-c", serve],
+        "env": _serving_env(cfg),
+        "ports": [{"containerPort": cfg.metrics_port, "name": "metrics"}],
+        "resources": {
+            "requests": {"cpu": cfg.cpu, "memory": cfg.memory},
+            "limits": {"cpu": cfg.cpu, "memory": cfg.memory,
+                       "google.com/tpu": str(_serving_chips(cfg))},
+        },
+        **_serving_probes(cfg),
+    }
+    if cfg.pre_stop_sleep_s:
+        # Same rolling-update race as the training worker: hold SIGTERM
+        # until the gateway/Service observes /readyz going 503 and stops
+        # routing new requests at this replica.
+        container["lifecycle"] = {
+            "preStop": {"exec": {"command":
+                ["/bin/sh", "-c", f"sleep {int(cfg.pre_stop_sleep_s)}"]}}}
+    return _serving_job(cfg, name=name, role="serve-replica",
+                        replicas=int(cfg.serve_replicas or 1),
+                        container=container, subdomain=name)
+
+
+def gateway_replica_endpoints(cfg: JobConfig) -> list[str]:
+    """The host:port each replica-server answers on, via Indexed-Job pod
+    DNS through the replica headless Service."""
+    name = f"{cfg.name}-replica"
+    return [f"{name}-{i}.{name}.{cfg.namespace}:{cfg.metrics_port}"
+            for i in range(int(cfg.serve_replicas or 1))]
+
+
+def render_gateway_job(cfg: JobConfig) -> dict:
+    """Gateway role: a single CPU-only pod running the failover gateway
+    over the remote replica fleet (serve/cli.py --replica-endpoints)."""
+    name = f"{cfg.name}-gateway"
+    command = ["python", "-m", "k8s_distributed_deeplearning_tpu.launch",
+               "serve",
+               "--replica-endpoints", ",".join(gateway_replica_endpoints(cfg)),
+               "--metrics-port", str(cfg.metrics_port)]
+    container = {
+        "name": "gateway",
+        "image": cfg.image,
+        "command": command,
+        "env": _serving_env(cfg),
+        "ports": [{"containerPort": cfg.metrics_port, "name": "metrics"}],
+        # No TPU claim: the gateway is pure HTTP dispatch + health routing.
+        "resources": {
+            "requests": {"cpu": cfg.cpu, "memory": cfg.memory},
+            "limits": {"cpu": cfg.cpu, "memory": cfg.memory},
+        },
+        **_serving_probes(cfg),
+    }
+    return _serving_job(cfg, name=name, role="serve-gateway", replicas=1,
+                        container=container, subdomain=name)
+
+
+def render_serving(cfg: JobConfig) -> list[dict]:
+    """The remote-serving tier: replica headless Service + replica-server
+    Indexed Job + gateway Job. Appended to :func:`render_all` output when
+    ``cfg.serve_replicas`` is set."""
+    return [render_replica_service(cfg), render_replica_job(cfg),
+            render_gateway_job(cfg)]
+
+
 def render_all(cfg: JobConfig) -> list[dict]:
-    return [render_namespace(cfg), render_service(cfg), render_tpujob(cfg)]
+    docs = [render_namespace(cfg), render_service(cfg), render_tpujob(cfg)]
+    if cfg.serve_replicas:
+        docs.extend(render_serving(cfg))
+    return docs
 
 
 def to_yaml(docs: list[dict]) -> str:

@@ -277,6 +277,127 @@ def _check_termination(errors, where: str, tmpl: dict,
                  "terminationGracePeriodSeconds or shrink the sleep")
 
 
+_SERVING_ROLES = frozenset({"serve-gateway", "serve-replica"})
+
+
+def _probe_port(probe: dict) -> object:
+    return (probe.get("httpGet") or {}).get("port")
+
+
+def _check_serving_probes(errors, where: str, c: dict) -> None:
+    """Both serving roles must split readiness from liveness: readiness
+    /readyz (503 while draining — the Service must stop routing before
+    the drain handshake) and liveness /healthz (200 while draining — a
+    kubelet restart mid-drain loses exactly the requests the drain
+    protects). A manifest pointing both probes at /healthz validates fine
+    against the k8s schema and only shows up as shed requests during the
+    first rolling update."""
+    env = {e.get("name"): (e.get("value") or "")
+           for e in c.get("env", []) if "value" in e}
+    port = env.get("TPUJOB_METRICS_PORT", "")
+    for kind, path in (("readinessProbe", "/readyz"),
+                       ("livenessProbe", "/healthz")):
+        probe = c.get(kind)
+        if not probe:
+            _err(errors, where, f"serving container {c.get('name')!r} has "
+                 f"no {kind} — the drain handshake depends on it")
+            continue
+        got = (probe.get("httpGet") or {}).get("path")
+        if got != path:
+            _err(errors, where, f"{kind} path {got!r} must be {path!r} "
+                 "(readiness and liveness are different contracts while "
+                 "draining)")
+        if port and str(_probe_port(probe)) != port:
+            _err(errors, where, f"{kind} port {_probe_port(probe)!r} != "
+                 f"TPUJOB_METRICS_PORT ({port})")
+
+
+def _gateway_endpoints(c: dict) -> list[str] | None:
+    """Pull --replica-endpoints out of the gateway command (list argv or
+    a ``sh -c`` string)."""
+    cmd = [str(a) for a in (c.get("command") or []) + (c.get("args") or [])]
+    argv: list[str] = []
+    for part in cmd:
+        argv.extend(part.split())
+    for i, a in enumerate(argv):
+        if a == "--replica-endpoints" and i + 1 < len(argv):
+            return [e for e in argv[i + 1].split(",") if e]
+        if a.startswith("--replica-endpoints="):
+            return [e for e in a.partition("=")[2].split(",") if e]
+    return None
+
+
+def _check_serving_job(errors, where: str, job: dict,
+                       by_kind: dict[str, list[dict]]) -> None:
+    """The remote-serving contract: probes split readiness/liveness, the
+    replica fleet has stable DNS through a headless Service, and the
+    gateway's static endpoint list matches the replica Job it is rendered
+    next to — a count or port drift here means a replica that is
+    scheduled, billed, and never dispatched to."""
+    role = (job["metadata"].get("labels") or {}).get("role")
+    spec = job.get("spec", {})
+    tmpl = spec.get("template", {}).get("spec", {})
+    containers = tmpl.get("containers") or []
+    for c in containers:
+        _check_serving_probes(errors, where, c)
+    subdomain = tmpl.get("subdomain")
+    svc = next((s for s in by_kind.get("Service", [])
+                if s["metadata"].get("name") == subdomain), None)
+    if role == "serve-replica":
+        metrics_ports = [p.get("containerPort")
+                         for c in containers for p in c.get("ports", [])]
+        if svc is None:
+            _err(errors, where, f"no headless Service named {subdomain!r} "
+                 "rendered — replica pod DNS (the gateway's endpoint "
+                 "list) will not resolve")
+        else:
+            if svc["spec"].get("clusterIP") != "None":
+                _err(errors, where, "replica Service must be headless "
+                     "(clusterIP: None) for per-pod DNS")
+            for p in [p.get("port") for p in svc["spec"].get("ports", [])]:
+                if p not in metrics_ports:
+                    _err(errors, where, f"replica Service port {p} not "
+                         f"exposed by the container ({metrics_ports})")
+        return
+    # Gateway: its endpoint list must agree with the replica Job.
+    eps = _gateway_endpoints(containers[0]) if containers else None
+    if eps is None:
+        # Discovery-dir gateways carry no static list; nothing to check.
+        return
+    replica_jobs = [j for j in by_kind.get("Job", [])
+                    if (j["metadata"].get("labels") or {}).get("role")
+                    == "serve-replica"]
+    if not replica_jobs:
+        _err(errors, where, "gateway has --replica-endpoints but no "
+             "serve-replica Job is rendered alongside")
+        return
+    rj = replica_jobs[0]
+    completions = rj.get("spec", {}).get("completions")
+    if len(eps) != completions:
+        _err(errors, where, f"gateway lists {len(eps)} replica endpoints "
+             f"but the replica Job has completions={completions}")
+    r_tmpl = rj.get("spec", {}).get("template", {}).get("spec", {})
+    r_sub = r_tmpl.get("subdomain")
+    r_name = rj["metadata"].get("name")
+    r_ns = rj["metadata"].get("namespace")
+    r_ports = {str(p.get("containerPort"))
+               for c in (r_tmpl.get("containers") or [])
+               for p in c.get("ports", [])}
+    for i, ep in enumerate(eps):
+        host, sep, port = ep.rpartition(":")
+        if not sep or not port.isdigit():
+            _err(errors, where, f"replica endpoint {ep!r} is not "
+                 "host:port with a numeric port")
+            continue
+        expect = f"{r_name}-{i}.{r_sub}.{r_ns}"
+        if host != expect:
+            _err(errors, where, f"replica endpoint host {host!r} != "
+                 f"<replica-job>-{i}.<subdomain>.<ns> ({expect!r})")
+        if port not in r_ports:
+            _err(errors, where, f"replica endpoint port {port} not "
+                 f"exposed by the replica container ({sorted(r_ports)})")
+
+
 def validate(docs: list[dict]) -> list[str]:
     """Validate rendered manifests; returns a list of errors (empty = OK)."""
     errors: list[str] = []
@@ -318,6 +439,12 @@ def validate(docs: list[dict]) -> list[str]:
         for c in containers:
             _check_container(errors, where, c)
         _check_termination(errors, where, tmpl, containers)
+
+        if (job["metadata"].get("labels") or {}).get("role") in _SERVING_ROLES:
+            # Serving roles have no jax.distributed gang — their contract
+            # is the probe split + gateway↔replica endpoint agreement.
+            _check_serving_job(errors, where, job, by_kind)
+            continue
 
         # The distributed-bootstrap contract (what a typo here costs: every
         # pod hangs in jax.distributed.initialize at startup).

@@ -89,7 +89,8 @@ class Kubectl:
 
     Transient failures (apiserver timeout, connection refused — the
     blips a live reconcile loop WILL meet over hours) are retried up to
-    *retries* times with exponential backoff starting at *backoff_s*;
+    *retries* times with full-jitter exponential backoff under the
+    *backoff_s* ceiling;
     anything else (NotFound, Forbidden, bad manifest) surfaces
     immediately. A watch must not die on the first network hiccup, and
     must also not retry forever against a genuinely broken config."""
@@ -97,11 +98,13 @@ class Kubectl:
     def __init__(self, context: str | None = None,
                  runner: Callable | None = None, *,
                  retries: int = 2, backoff_s: float = 1.0,
-                 sleep: Callable[[float], None] = time.sleep):
+                 sleep: Callable[[float], None] = time.sleep,
+                 rng: Callable[[], float] | None = None):
         self.context = context
         self.retries = retries
         self.backoff_s = backoff_s
         self._sleep = sleep
+        self._rng = rng
         self._runner = runner or self._subprocess_runner
 
     def _subprocess_runner(self, args: list[str], input_text: str | None,
@@ -141,9 +144,12 @@ class Kubectl:
             return rc, out, err
 
         try:
+            # jitter=True: every watcher replica backing off an apiserver
+            # blip in lockstep is exactly the thundering herd that keeps
+            # the apiserver down.
             return retry_transient(
                 attempt, retries=self.retries, backoff_s=self.backoff_s,
-                sleep=self._sleep,
+                sleep=self._sleep, jitter=True, rng=self._rng,
                 # Surfaced kubectl timeouts (RuntimeError) retry too.
                 is_transient=lambda e: isinstance(e, _TransientRC) or (
                     isinstance(e, RuntimeError) and _is_transient(str(e))))

@@ -53,6 +53,39 @@ def main(argv: list[str] | None = None) -> int:
                          "a second replica when its first token is still "
                          "missing after S seconds (first stream wins, "
                          "loser is cancelled); omitted = no hedging")
+    ap.add_argument("--replica-server", action="store_true",
+                    help="run ONE engine as a standalone replica-server "
+                         "process (serve/transport.py): the transport "
+                         "endpoints (/submit /poll /cancel /drain "
+                         "/shutdown) share the /metrics exporter on "
+                         "--metrics-port, a remote gateway drives the "
+                         "workload, and SIGTERM drains then exits 0")
+    ap.add_argument("--port-file", default=None, metavar="PATH",
+                    help="replica-server only: write the bound port here "
+                         "once listening (use with --metrics-port 0 for "
+                         "an ephemeral port in tests)")
+    ap.add_argument("--heartbeat-dir", default=None, metavar="DIR",
+                    help="replica-server only: advertise this replica's "
+                         "metrics_addr through heartbeat files in DIR "
+                         "(the gateway's --replica-discovery-dir reads "
+                         "the same directory)")
+    ap.add_argument("--replica-rank", type=int, default=0,
+                    help="replica-server only: heartbeat rank / identity "
+                         "of this replica process")
+    ap.add_argument("--advertise-host", default="127.0.0.1",
+                    help="replica-server only: host written into the "
+                         "advertised metrics_addr (the address peers "
+                         "dial, not the bind address)")
+    ap.add_argument("--replica-endpoints", default=None, metavar="LIST",
+                    help="run the gateway over REMOTE replica-server "
+                         "processes at these comma-separated host:port "
+                         "endpoints instead of in-process engines (no "
+                         "local model is built)")
+    ap.add_argument("--replica-discovery-dir", default=None, metavar="DIR",
+                    help="like --replica-endpoints, but discover the "
+                         "fleet from heartbeat files carrying "
+                         "metrics_addr (written by replica-servers "
+                         "started with --heartbeat-dir DIR)")
     ap.add_argument("--requests", type=int, default=32)
     ap.add_argument("--max-queue", type=int, default=None,
                     help="admission queue bound (default: number of "
@@ -163,7 +196,33 @@ def main(argv: list[str] | None = None) -> int:
                  "rides the metrics exporter)")
     if args.replicas < 1:
         ap.error(f"--replicas must be >= 1, got {args.replicas}")
-    if args.hedge_after_s is not None and args.replicas < 2:
+    remote = (args.replica_endpoints is not None
+              or args.replica_discovery_dir is not None)
+    if args.replica_endpoints is not None and args.replica_discovery_dir:
+        ap.error("--replica-endpoints and --replica-discovery-dir are "
+                 "mutually exclusive (static list vs heartbeat discovery)")
+    if args.replica_server and remote:
+        ap.error("--replica-server runs the engine side; "
+                 "--replica-endpoints/--replica-discovery-dir run the "
+                 "gateway side — pick one per process")
+    if args.replica_server and args.replicas != 1:
+        ap.error("--replica-server wraps exactly one engine per process "
+                 f"(got --replicas {args.replicas}); scale out by "
+                 "starting more replica-server processes")
+    if args.replica_server and args.metrics_port is None:
+        ap.error("--replica-server requires --metrics-port (the transport "
+                 "endpoints ride the metrics exporter; 0 = ephemeral "
+                 "with --port-file)")
+    if args.port_file is not None and not args.replica_server:
+        ap.error("--port-file only makes sense with --replica-server")
+    if args.heartbeat_dir is not None and not args.replica_server:
+        ap.error("--heartbeat-dir only makes sense with --replica-server "
+                 "(gateways discover via --replica-discovery-dir)")
+    if remote and args.draft_model is not None:
+        ap.error("speculative decoding is an engine-side knob: pass "
+                 "--draft-model to the replica-server processes, not "
+                 "the remote gateway")
+    if args.hedge_after_s is not None and args.replicas < 2 and not remote:
         ap.error("--hedge-after-s needs --replicas >= 2 (hedging "
                  "duplicates a dispatch onto a PEER replica)")
     if args.hedge_after_s is not None and args.hedge_after_s <= 0:
@@ -210,9 +269,10 @@ def main(argv: list[str] | None = None) -> int:
     else:
         cfg = llama.config_tiny(max_seq_len=args.max_seq_len,
                                 dtype=jnp.float32)
-    model = llama.LlamaLM(cfg)
-    params = model.init(jax.random.PRNGKey(args.seed),
-                        jnp.zeros((1, 8), jnp.int32))["params"]
+    if not remote:
+        model = llama.LlamaLM(cfg)
+        params = model.init(jax.random.PRNGKey(args.seed),
+                            jnp.zeros((1, 8), jnp.int32))["params"]
 
     draft_model = draft_params = None
     if args.draft_model is not None:
@@ -235,7 +295,11 @@ def main(argv: list[str] | None = None) -> int:
 
     p_lo, p_hi = args.prompt_len
     o_lo, o_hi = args.out_len
-    if args.shared_prefix_len + p_hi + o_hi > cfg.max_seq_len:
+    # A replica server generates no workload of its own — the gateway
+    # shapes every request it serves — so the synthetic-workload bounds
+    # only apply to the driving modes.
+    if not args.replica_server and \
+            args.shared_prefix_len + p_hi + o_hi > cfg.max_seq_len:
         ap.error(f"shared-prefix-len ({args.shared_prefix_len}) + "
                  f"prompt-len hi ({p_hi}) + out-len hi ({o_hi}) exceeds "
                  f"--max-seq-len ({cfg.max_seq_len})")
@@ -264,7 +328,7 @@ def main(argv: list[str] | None = None) -> int:
     # scrape surface (the process is single-threaded, so increment-only
     # sharing is safe).
     stats = ServingStats()
-    engines = [
+    engines = [] if remote else [
         ServeEngine(
             model, params, num_slots=args.slots,
             max_queue=args.max_queue or args.requests,
@@ -278,13 +342,41 @@ def main(argv: list[str] | None = None) -> int:
             spec_k=args.spec_k, flight=flight,
             replica_id=f"r{i}" if args.replicas > 1 else None)
         for i in range(args.replicas)]
-    engine = engines[0]
+    engine = engines[0] if engines else None
+    clients = None
     gateway = None
-    if args.replicas > 1:
+    if remote:
+        from k8s_distributed_deeplearning_tpu.serve.transport import (
+            ReplicaClient, discover_replica_clients)
+        if args.replica_discovery_dir is not None:
+            clients = discover_replica_clients(
+                args.replica_discovery_dir, stats=stats, logger=logger,
+                flight=flight)
+            if not clients:
+                ap.error(f"--replica-discovery-dir "
+                         f"{args.replica_discovery_dir}: no heartbeat "
+                         f"advertises a metrics_addr (are the "
+                         f"replica-servers up, with --heartbeat-dir?)")
+        else:
+            clients = [
+                ReplicaClient(ep.strip(), stats=stats, logger=logger,
+                              flight=flight)
+                for ep in args.replica_endpoints.split(",") if ep.strip()]
+            if not clients:
+                ap.error("--replica-endpoints: empty endpoint list")
+        if args.hedge_after_s is not None and len(clients) < 2:
+            ap.error("--hedge-after-s needs >= 2 remote replicas")
+        gateway = ServeGateway(clients, stats=stats, logger=logger,
+                               hedge_after_s=args.hedge_after_s,
+                               flight=flight)
+    elif args.replicas > 1:
         gateway = ServeGateway(engines, stats=stats, logger=logger,
                                hedge_after_s=args.hedge_after_s,
                                flight=flight)
     front = gateway if gateway is not None else engine
+    # What the probes report on: remote mode watches the clients' cached
+    # replica states, local mode the engines themselves.
+    status_objs = clients if clients is not None else engines
 
     # SIGTERM → cooperative drain → exit 0: the k8s eviction handshake.
     # The handler only flips drain mode (stop admitting); the serving
@@ -300,13 +392,52 @@ def main(argv: list[str] | None = None) -> int:
         # interrupted — before drain mode starts changing it.
         if flight is not None:
             flight.dump("sigterm")
-        for e in engines:
-            e.drain()
+        if clients is not None:
+            # Remote fleet: cooperative drain THROUGH the gateway so
+            # queued work migrates between replicas instead of dying
+            # with this process's view of them.
+            for rid in list(gateway.snapshot()["replicas"]):
+                gateway.drain_replica(rid)
+        else:
+            for e in engines:
+                e.drain()
 
     try:
         signal.signal(signal.SIGTERM, _on_sigterm)
     except ValueError:
         pass              # not the main thread (embedded use): no handler
+
+    if args.replica_server:
+        # Engine side of the wire: no local workload — a remote gateway
+        # submits over the transport endpoints. Blocks until /shutdown
+        # or a SIGTERM-initiated drain finishes (then exits 0: the k8s
+        # eviction handshake, proven end-to-end in tests/test_transport).
+        import time as _time
+
+        from k8s_distributed_deeplearning_tpu.serve.transport import (
+            ReplicaServer)
+        engine.replica_id = engine.replica_id or f"r{args.replica_rank}"
+        server = ReplicaServer(
+            engine, host="0.0.0.0", port=args.metrics_port,
+            advertise_host=args.advertise_host, logger=logger,
+            heartbeat_dir=args.heartbeat_dir, rank=args.replica_rank,
+            flight=flight).start()
+        if args.port_file:
+            with open(args.port_file, "w") as f:
+                f.write(f"{server.port}\n")
+        logger.emit("start", role="replica_server", port=server.port,
+                    replica=engine.replica_id, preset=args.preset,
+                    num_slots=args.slots)
+        while not server.shutting_down:
+            if drain_requested and server.drained:
+                break
+            _time.sleep(0.02)
+        logger.emit("replica_drained", replica=engine.replica_id)
+        logger.emit("serve_summary", num_slots=args.slots,
+                    preset=args.preset, replicas=1, **stats.summary())
+        server.close()
+        logger.close()
+        return 0
 
     exporter = None
     if args.metrics_port is not None:
@@ -327,9 +458,22 @@ def main(argv: list[str] | None = None) -> int:
             registry, port=args.metrics_port,
             tracer=tracer if args.debug_dir is not None else None,
             profile_dir=args.debug_dir, flight=flight,
-            healthz=lambda: _drain_status(engines)).start()
+            healthz=lambda: _drain_status(status_objs),
+            # Readiness splits from liveness: 503 the moment a drain
+            # starts (stop routing here) while /healthz stays 200 (do
+            # not restart a draining pod).
+            readyz=lambda: {
+                "ready": not any(e.draining for e in status_objs),
+                **_drain_status(status_objs)}).start()
     shared = rng.integers(0, cfg.vocab_size, size=args.shared_prefix_len)
-    tenant_ids = engine.queue.tenant_ids()
+    if engine is not None:
+        tenant_ids = engine.queue.tenant_ids()
+    elif tenant_cfgs is not None:
+        # Remote mode: admission control lives replica-side; the feed
+        # only needs the ids to tag requests with.
+        tenant_ids = [c.tenant_id for c in tenant_cfgs]
+    else:
+        tenant_ids = ["default"]
     from collections import deque
     feed = deque()
     for i in range(args.requests):

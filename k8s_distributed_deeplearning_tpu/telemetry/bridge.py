@@ -157,6 +157,18 @@ def serving_collector(registry: MetricsRegistry,
         "serve_gateway_breaker_trips_total": registry.gauge(
             "serve_gateway_breaker_trips_total",
             "per-replica circuit breaker open transitions"),
+        "serve_transport_retries_total": registry.gauge(
+            "serve_transport_retries_total",
+            "remote-replica transport calls retried after a transient "
+            "failure (connection error / timeout / injected fault)"),
+        "serve_transport_dedup_hits_total": registry.gauge(
+            "serve_transport_dedup_hits_total",
+            "retried submits the replica server deduplicated by "
+            "request_id (ambiguous failures resolved exactly-once)"),
+        "serve_transport_reconnects_total": registry.gauge(
+            "serve_transport_reconnects_total",
+            "token streams resumed from their emitted-token cursor "
+            "after failed polls"),
         "serve_spec_steps_total": registry.gauge(
             "serve_spec_steps_total",
             "speculative (draft-and-verify) decode iterations run"),
@@ -210,7 +222,10 @@ def serving_collector(registry: MetricsRegistry,
                "spec_steps": "serve_spec_steps_total",
                "spec_proposed_tokens": "serve_spec_proposed_tokens_total",
                "spec_accepted_tokens": "serve_spec_accepted_tokens_total",
-               "spec_acceptance_rate": "serve_spec_acceptance_rate"}
+               "spec_acceptance_rate": "serve_spec_acceptance_rate",
+               "transport_retries": "serve_transport_retries_total",
+               "transport_dedup_hits": "serve_transport_dedup_hits_total",
+               "transport_reconnects": "serve_transport_reconnects_total"}
 
     def collect() -> None:
         summ = stats.summary()

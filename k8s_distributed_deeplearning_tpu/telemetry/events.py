@@ -69,6 +69,16 @@ EVENTS: dict[str, str] = {
     "kv_page_leak": "drain/shutdown leak guard: non-scratch KV pages "
                     "still held after the engine released everything "
                     "(count and by-owner attribution attached)",
+    "transport_retry": "a remote-replica transport call failed "
+                       "transiently and is being retried with jittered "
+                       "backoff (replica, call, attempt, delay attached)",
+    "transport_submit_deduped": "a retried submit after an ambiguous "
+                                "failure (request landed, response lost) "
+                                "was deduplicated by the replica server — "
+                                "idempotency by request_id held",
+    "transport_reconnect": "a replica's token stream resumed from its "
+                           "emitted-token cursor after one or more failed "
+                           "polls (replica and cursor positions attached)",
 }
 
 _SNAKE = re.compile(r"^[a-z][a-z0-9_]*$")

@@ -4,9 +4,10 @@ The scrape surface behind the ``prometheus.io/*`` pod annotations that
 ``launch/render.py`` stamps on every worker: Prometheus (or a curl) GETs
 ``/metrics`` for text-format 0.0.4 exposition of a
 :class:`telemetry.registry.MetricsRegistry`, and K8s probes GET
-``/healthz`` for a JSON liveness answer. ``ThreadingHTTPServer`` on a
-daemon thread: scrapes never block a train step, and the process never
-waits on the exporter to exit.
+``/healthz`` for a JSON liveness answer and ``/readyz`` for readiness
+(503 once a drain starts — alive but not routable; see the ``readyz``
+ctor arg). ``ThreadingHTTPServer`` on a daemon thread: scrapes never
+block a train step, and the process never waits on the exporter to exit.
 
 ``port=0`` binds an ephemeral port (tests; ``.port`` reports the choice).
 
@@ -62,6 +63,21 @@ class MetricsExporter:
     one scrape target for the whole fleet. *slo* (a
     :class:`telemetry.slo.SLOEngine`) rides into the ``/fleet`` body.
 
+    *readyz* splits READINESS from the liveness above: ``/readyz``
+    answers 200 while the callable returns a truthy ``"ready"`` field and
+    503 once it stops (or raises) — a draining server is alive (don't
+    restart it) but not ready (stop routing to it), which is exactly the
+    distinction k8s readiness vs liveness probes encode. With no *readyz*
+    configured, ``/readyz`` mirrors ``/healthz`` (a process with no drain
+    concept is ready iff alive).
+
+    *routes* mounts extra endpoints on this same server — the serving
+    transport (``serve/transport.py``) shares the exporter's hardened
+    machinery instead of growing a second HTTP stack. Each entry maps a
+    path to ``handler(method, query, body) -> (code, ctype, bytes)``;
+    returning None drops the connection without a response (the injected
+    "response lost" fault shape). Handler exceptions answer 500.
+
     *handler_timeout* is the per-connection socket timeout: a scraper
     that connects and then goes silent would otherwise pin one
     ``ThreadingHTTPServer`` handler thread per hung connection forever
@@ -74,12 +90,16 @@ class MetricsExporter:
     def __init__(self, registry: MetricsRegistry, *, host: str = "0.0.0.0",
                  port: int = 9090,
                  healthz: Callable[[], dict] | None = None,
+                 readyz: Callable[[], dict] | None = None,
+                 routes: dict[str, Callable] | None = None,
                  tracer=None, profile_dir: str | None = None,
                  profiler: Callable | None = None,
                  fleet=None, slo=None, flight=None,
                  handler_timeout: float = 30.0):
         self.registry = registry
         self.healthz = healthz
+        self.readyz = readyz
+        self.routes = dict(routes) if routes else {}
         self.tracer = tracer
         self.profile_dir = profile_dir
         self._profiler = profiler
@@ -140,6 +160,10 @@ class MetricsExporter:
                         body = json.dumps({"ok": False,
                                            "error": repr(e)}).encode()
                         self._reply(503, "application/json", body)
+                elif path == "/readyz":
+                    self._readyz()
+                elif path in exporter.routes:
+                    self._route(path, "GET", query)
                 elif path == "/debug/spans":
                     self._debug_spans()
                 elif path == "/debug/profile":
@@ -148,6 +172,53 @@ class MetricsExporter:
                     self._debug_flight(query)
                 else:
                     self._reply(404, "text/plain", b"not found\n")
+
+            def do_POST(self):
+                path, _, query = self.path.partition("?")
+                if path not in exporter.routes:
+                    self._reply(404, "text/plain", b"not found\n")
+                    return
+                self._route(path, "POST", query)
+
+            def _route(self, path: str, method: str, query: str) -> None:
+                try:
+                    n = int(self.headers.get("Content-Length") or 0)
+                    body = self.rfile.read(n) if n else b""
+                except (OSError, ValueError):
+                    self.close_connection = True
+                    return
+                try:
+                    result = exporter.routes[path](method, query, body)
+                except Exception as e:   # handler bug/injected fault that
+                    # escaped: answer 500 instead of a silent hangup, so
+                    # the client can tell "broken handler" from "severed
+                    # link" (the latter is the None contract below).
+                    self._reply(500, "application/json", json.dumps(
+                        {"error": repr(e)}).encode())
+                    return
+                if result is None:
+                    # The handler asked for a DROPPED response (the
+                    # transport_recv fault shape): the request was
+                    # processed but the reply vanishes on the wire.
+                    self.close_connection = True
+                    return
+                code, ctype, payload = result
+                self._reply(code, ctype, payload)
+
+            def _readyz(self) -> None:
+                probe = exporter.readyz or exporter.healthz or (lambda: {})
+                try:
+                    extra = probe()
+                    ready = bool(extra.get("ready", True)) if isinstance(
+                        extra, dict) else bool(extra)
+                    body = json.dumps({"ready": ready,
+                                       **(extra if isinstance(extra, dict)
+                                          else {})}).encode()
+                    self._reply(200 if ready else 503, "application/json",
+                                body)
+                except Exception as e:
+                    self._reply(503, "application/json", json.dumps(
+                        {"ready": False, "error": repr(e)}).encode())
 
             def _fleet(self) -> None:
                 if exporter.fleet is None:

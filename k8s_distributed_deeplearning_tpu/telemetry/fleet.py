@@ -223,8 +223,9 @@ class FleetScraper:
     default uses urllib with the per-endpoint *timeout_s*.
 
     Each :meth:`poll` scrapes all endpoints; a failing endpoint is
-    retried *retries* times with exponential backoff starting at
-    *backoff_s* (the shared ``utils.retry`` policy, *sleep* injectable),
+    retried *retries* times with full-jitter exponential backoff under
+    the *backoff_s* ceiling (the shared ``utils.retry`` policy; *sleep*
+    and the jitter *rng* are injectable),
     then marked failed for this round — its last good families stick
     around, aging toward staleness, and one ``fleet_scrape_failed``
     event is emitted per failure episode (not per poll) through
@@ -236,6 +237,7 @@ class FleetScraper:
                  fetch: Callable[[str, float], str] | None = None,
                  clock: Callable[[], float] = time.time,
                  sleep: Callable[[float], None] = time.sleep,
+                 rng: Callable[[], float] | None = None,
                  logger=None):
         self.timeout_s = timeout_s
         self.retries = retries
@@ -243,6 +245,7 @@ class FleetScraper:
         self.stale_after_s = stale_after_s
         self.clock = clock
         self._sleep = sleep
+        self._rng = rng
         self._fetch = fetch or self._urllib_fetch
         self.logger = logger if logger is not None else _NullLogger()
         self.replicas: dict[str, ReplicaState] = {}
@@ -268,10 +271,12 @@ class FleetScraper:
             now = self.clock()
             state.last_attempt = now
             try:
+                # Full-jitter backoff: N pollers retrying a shared dead
+                # replica must not re-converge in lockstep.
                 text = retry_transient(
                     lambda: self._fetch(state.url, self.timeout_s),
                     retries=self.retries, backoff_s=self.backoff_s,
-                    sleep=self._sleep,
+                    sleep=self._sleep, jitter=True, rng=self._rng,
                     is_transient=lambda e: isinstance(
                         e, (OSError, TimeoutError)))
                 state.families = parse_exposition(text)

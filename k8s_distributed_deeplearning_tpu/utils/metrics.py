@@ -138,6 +138,13 @@ class ServingStats:
         self.gateway_migrations = 0
         self.gateway_hedges = 0
         self.gateway_breaker_trips = 0
+        # Remote-replica transport (serve/transport.py): transient-call
+        # retries, idempotent submits the replica server deduplicated
+        # (the ambiguous-failure path working as designed), and token
+        # streams resumed from their cursor after failed polls.
+        self.transport_retries = 0
+        self.transport_dedup_hits = 0
+        self.transport_reconnects = 0
         # Speculative decoding (draft-and-verify): draft tokens proposed
         # vs accepted-and-emitted, spec iterations run, and a histogram
         # of accepted-draft count per slot-iteration (key 0..spec_k — the
@@ -247,6 +254,25 @@ class ServingStats:
         self._tick()
         self.gateway_breaker_trips += 1
 
+    def record_transport_retry(self) -> None:
+        """One remote-replica transport call retried after a transient
+        failure (connection error / timeout / injected network fault)."""
+        self._tick()
+        self.transport_retries += 1
+
+    def record_transport_dedup(self) -> None:
+        """One retried submit was deduplicated by the replica server —
+        the request had landed but its response was lost (the ambiguous
+        failure idempotent submit exists for)."""
+        self._tick()
+        self.transport_dedup_hits += 1
+
+    def record_transport_reconnect(self) -> None:
+        """One token stream resumed from its emitted-token cursor after
+        one or more failed polls (exactly-once splice held)."""
+        self._tick()
+        self.transport_reconnects += 1
+
     def record_completion(self, latency_s: float, n_tokens: int,
                           reason: str) -> None:
         self._tick()
@@ -300,6 +326,9 @@ class ServingStats:
             "gateway_migrations": self.gateway_migrations,
             "gateway_hedges": self.gateway_hedges,
             "gateway_breaker_trips": self.gateway_breaker_trips,
+            "transport_retries": self.transport_retries,
+            "transport_dedup_hits": self.transport_dedup_hits,
+            "transport_reconnects": self.transport_reconnects,
             "spec_steps": self.spec_steps,
             "spec_proposed_tokens": self.spec_proposed_tokens,
             "spec_accepted_tokens": self.spec_accepted_tokens,

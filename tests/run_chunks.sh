@@ -69,9 +69,14 @@ CHUNKS[spec]="tests/test_spec.py tests/test_pallas_paged_attn.py"
 # jax-free unit tests plus engine+gateway chaos cases that compile their
 # own tiny models — its own chunk so serve/gateway stay under timeout.
 CHUNKS[flight]="tests/test_flight.py"
+# graftwire (cross-process replica transport): jitter/fault-site units run
+# jax-free, but the remote-gateway parity and replica-kill cases compile
+# real engines behind ReplicaServer threads — its own chunk, and the slow
+# marker holds the subprocess SIGTERM-drain e2e (three CLI processes).
+CHUNKS[transport]="tests/test_transport.py"
 CHUNKS[slow1]="tests/test_train_e2e.py tests/test_multiprocess.py"
 CHUNKS[slow2]="tests/test_multihost_train.py tests/test_multihost_llama.py tests/test_train_zoo.py"
-ORDER=(lint core parallel1 parallel2 moe train llama deploy serve sched paged faults graftscope fleet gateway spec flight slow1 slow2)
+ORDER=(lint core parallel1 parallel2 moe train llama deploy serve sched paged faults graftscope fleet gateway spec flight transport slow1 slow2)
 
 # --- completeness check: every tests/test_*.py in EXACTLY one chunk ------
 # ...and every declared chunk actually in ORDER: a chunk missing from the

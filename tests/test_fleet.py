@@ -101,6 +101,9 @@ def _scripted(script, **kw):
         return item
 
     kw.setdefault("backoff_s", 0.2)
+    # rng pinned to 1.0: full-jitter delay == ceiling, keeping the sleep
+    # schedule assertions exact.
+    kw.setdefault("rng", lambda: 1.0)
     scraper = FleetScraper(["r1:9090"], fetch=fetch,
                            clock=lambda: clock["t"], sleep=sleeps.append,
                            **kw)

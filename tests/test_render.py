@@ -314,3 +314,88 @@ def test_prestop_sleep_must_fit_inside_grace_period():
     errs = validate.validate(render.render_all(
         JobConfig(num_workers=2, termination_grace_s=0)))
     assert any("must be a positive integer" in e for e in errs)
+
+
+def _serving_docs(**kw):
+    return render.render_all(JobConfig(serve_replicas=3, **kw))
+
+
+def test_serving_roles_render_and_validate():
+    """serve_replicas adds a second tier: headless replica Service, an
+    Indexed replica-server Job and a single-pod gateway Job whose static
+    endpoint list is the replica pods' stable DNS."""
+    from k8s_distributed_deeplearning_tpu.launch import validate
+
+    docs = _serving_docs(name="svc", namespace="ns", metrics_port=9200,
+                         termination_grace_s=60, pre_stop_sleep_s=5)
+    assert validate.validate(docs) == []
+    by_name = {(d["kind"], d["metadata"]["name"]): d for d in docs}
+    svc = by_name[("Service", "svc-replica")]
+    rep = by_name[("Job", "svc-replica")]
+    gw = by_name[("Job", "svc-gateway")]
+    assert svc["spec"]["clusterIP"] is None or \
+        svc["spec"]["clusterIP"] == "None"
+    assert rep["spec"]["completions"] == 3
+    assert rep["spec"]["completionMode"] == "Indexed"
+    assert gw["spec"]["completions"] == 1
+    eps = render.gateway_replica_endpoints(
+        JobConfig(name="svc", namespace="ns", metrics_port=9200,
+                  serve_replicas=3))
+    assert eps == [f"svc-replica-{i}.svc-replica.ns:9200" for i in range(3)]
+    gw_cmd = " ".join(gw["spec"]["template"]["spec"]["containers"][0]
+                      ["command"])
+    assert ",".join(eps) in gw_cmd
+
+
+def test_serving_probes_split_readiness_from_liveness():
+    """Both serving roles probe readiness at /readyz (503 once draining)
+    and liveness at /healthz (200 while draining); pointing readiness at
+    /healthz would keep routing to a draining pod and is rejected."""
+    from k8s_distributed_deeplearning_tpu.launch import validate
+
+    docs = _serving_docs()
+    roles = {(d["metadata"].get("labels") or {}).get("role"): d
+             for d in docs if d["kind"] == "Job"}
+    for role in ("serve-replica", "serve-gateway"):
+        c = roles[role]["spec"]["template"]["spec"]["containers"][0]
+        assert c["readinessProbe"]["httpGet"]["path"] == "/readyz"
+        assert c["livenessProbe"]["httpGet"]["path"] == "/healthz"
+        assert c["readinessProbe"]["httpGet"]["port"] == 9090
+    # Collapse the split -> validation names the broken contract.
+    c = roles["serve-replica"]["spec"]["template"]["spec"]["containers"][0]
+    c["readinessProbe"]["httpGet"]["path"] = "/healthz"
+    errs = validate.validate(docs)
+    assert any("must be '/readyz'" in e for e in errs)
+    del c["livenessProbe"]
+    errs = validate.validate(docs)
+    assert any("no livenessProbe" in e for e in errs)
+
+
+def test_gateway_endpoint_drift_and_headless_service_are_caught():
+    """A gateway endpoint list that disagrees with the replica Job's
+    completions means replicas that are scheduled and never dispatched
+    to; a ClusterIP replica Service breaks the per-pod DNS the endpoint
+    list is built from. Both validate fine against the k8s schema."""
+    from k8s_distributed_deeplearning_tpu.launch import validate
+
+    docs = _serving_docs()
+    rep = next(d for d in docs if d["kind"] == "Job" and
+               (d["metadata"].get("labels") or {}).get("role")
+               == "serve-replica")
+    rep["spec"]["completions"] = rep["spec"]["parallelism"] = 2
+    errs = validate.validate(docs)
+    assert any("gateway lists 3 replica endpoints but the replica Job "
+               "has completions=2" in e for e in errs)
+
+    docs = _serving_docs()
+    svc = next(d for d in docs if d["kind"] == "Service"
+               and d["metadata"]["name"].endswith("-replica"))
+    svc["spec"]["clusterIP"] = "10.0.0.7"
+    errs = validate.validate(docs)
+    assert any("must be headless" in e for e in errs)
+
+    docs = [d for d in _serving_docs()
+            if not (d["kind"] == "Service"
+                    and d["metadata"]["name"].endswith("-replica"))]
+    errs = validate.validate(docs)
+    assert any("no headless Service named" in e for e in errs)

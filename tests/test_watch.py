@@ -131,6 +131,9 @@ class FlakyRunner:
 
 def _kubectl(runner, **kw):
     sleeps = []
+    # rng pinned to 1.0: the full-jitter delay equals its ceiling, so the
+    # schedule assertions below stay exact (pure doubling from backoff_s).
+    kw.setdefault("rng", lambda: 1.0)
     k = watch_mod.Kubectl(runner=runner, sleep=sleeps.append, **kw)
     return k, sleeps
 
