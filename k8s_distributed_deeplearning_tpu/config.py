@@ -167,6 +167,18 @@ class JobConfig:
     # must be < termination_grace_s (validate.py enforces). None/0 = no
     # preStop hook.
     pre_stop_sleep_s: int | None = None
+    # Elastic serving (serve/autoscale.py): when autoscale_max is set the
+    # gateway role runs the fleet controller (serve/cli.py --autoscale),
+    # scaling the replica set between autoscale_min and autoscale_max on
+    # SLO burn / queue pressure and walking the brownout ladder at max.
+    # Rendered as $TPUJOB_AUTOSCALE_{MIN,MAX,UP_COOLDOWN_S,DOWN_COOLDOWN_S,
+    # BROWNOUT}; validate.py enforces min <= max, positive cooldowns, and
+    # known brownout stage names offline.
+    autoscale_min: int | None = None
+    autoscale_max: int | None = None
+    autoscale_up_cooldown_s: float | None = None
+    autoscale_down_cooldown_s: float | None = None
+    autoscale_brownout: str | None = None  # comma-separated stage names
 
     def chips_per_worker(self) -> int:
         """TPU chips each pod must request: the slice's chip total (product of

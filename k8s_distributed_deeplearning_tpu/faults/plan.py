@@ -40,6 +40,12 @@ Sites (where the hook points live):
                        ``ioerror`` here are the AMBIGUOUS failure (request
                        landed, response lost), the case idempotent submit
                        exists for; ``stall`` is response latency
+- ``autoscale_actuate`` fleet controller (``serve/autoscale.py``), before
+                       each backend start/stop actuation — ``step``
+                       carries the CONTROL-ROUND index; ``ioerror`` = the
+                       actuation fails (spawn/patch error, retried next
+                       round), ``stall`` = slow actuation, ``exit`` = the
+                       controller process dies mid-actuation
 
 Actions (what happens when the trigger matches):
 
@@ -71,7 +77,7 @@ import json
 
 SITES = ("step", "data_wait", "shard_read", "checkpoint_saved", "heartbeat",
          "serve_decode", "gateway_dispatch", "executor", "transport_send",
-         "transport_recv")
+         "transport_recv", "autoscale_actuate")
 ACTIONS = ("exit", "sigterm", "stall", "ioerror", "truncate", "corrupt",
            "stop", "drop", "partition")
 
@@ -88,6 +94,7 @@ _SITE_ACTIONS = {
     "executor": ("exit", "sigterm"),
     "transport_send": ("ioerror", "stall", "drop", "partition"),
     "transport_recv": ("ioerror", "stall", "drop", "partition"),
+    "autoscale_actuate": ("ioerror", "stall", "exit"),
 }
 
 

@@ -206,6 +206,25 @@ def _serving_env(cfg: JobConfig) -> list[dict]:
         env.append({"name": "TPUJOB_FAULT_PLAN", "value": cfg.fault_plan})
     if cfg.tenants:
         env.append({"name": "TPUJOB_TENANTS", "value": cfg.tenants})
+    # Elastic serving (serve/autoscale.py): each knob renders
+    # independently so a dangling half (min without max, an unknown
+    # brownout stage) is VISIBLE in the manifest — validate.py flags it
+    # offline, before anything is applied to a cluster.
+    if cfg.autoscale_min is not None:
+        env.append({"name": "TPUJOB_AUTOSCALE_MIN",
+                    "value": str(cfg.autoscale_min)})
+    if cfg.autoscale_max is not None:
+        env.append({"name": "TPUJOB_AUTOSCALE_MAX",
+                    "value": str(cfg.autoscale_max)})
+    if cfg.autoscale_up_cooldown_s is not None:
+        env.append({"name": "TPUJOB_AUTOSCALE_UP_COOLDOWN_S",
+                    "value": str(cfg.autoscale_up_cooldown_s)})
+    if cfg.autoscale_down_cooldown_s is not None:
+        env.append({"name": "TPUJOB_AUTOSCALE_DOWN_COOLDOWN_S",
+                    "value": str(cfg.autoscale_down_cooldown_s)})
+    if cfg.autoscale_brownout is not None:
+        env.append({"name": "TPUJOB_AUTOSCALE_BROWNOUT",
+                    "value": cfg.autoscale_brownout})
     return env
 
 
@@ -337,6 +356,27 @@ def render_gateway_job(cfg: JobConfig) -> dict:
                "serve",
                "--replica-endpoints", ",".join(gateway_replica_endpoints(cfg)),
                "--metrics-port", str(cfg.metrics_port)]
+    if cfg.autoscale_max is not None:
+        # Elastic gateway: the fleet controller runs in this pod and
+        # patches the replica Job's parallelism through kubectl
+        # (serve/autoscale.py K8sParallelismBackend).
+        rep = f"{cfg.name}-replica"
+        command += ["--autoscale",
+                    "--autoscale-min", str(cfg.autoscale_min or 1),
+                    "--autoscale-max", str(cfg.autoscale_max),
+                    "--autoscale-k8s-job", rep,
+                    "--autoscale-k8s-namespace", cfg.namespace,
+                    "--autoscale-endpoint-template",
+                    f"{rep}-{{i}}.{rep}.{cfg.namespace}"
+                    f":{cfg.metrics_port}"]
+        if cfg.autoscale_up_cooldown_s is not None:
+            command += ["--autoscale-up-cooldown-s",
+                        str(cfg.autoscale_up_cooldown_s)]
+        if cfg.autoscale_down_cooldown_s is not None:
+            command += ["--autoscale-down-cooldown-s",
+                        str(cfg.autoscale_down_cooldown_s)]
+        if cfg.autoscale_brownout is not None:
+            command += ["--autoscale-brownout", cfg.autoscale_brownout]
     container = {
         "name": "gateway",
         "image": cfg.image,

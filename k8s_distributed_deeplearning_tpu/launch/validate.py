@@ -74,6 +74,7 @@ def _check_container(errors, where: str, c: dict) -> None:
     _check_fleet_endpoints(errors, where, c)
     _check_spec(errors, where, c)
     _check_flight(errors, where, c)
+    _check_autoscale(errors, where, c)
 
 
 def _hooked_sites() -> frozenset[str]:
@@ -237,6 +238,71 @@ def _check_flight(errors, where: str, c: dict) -> None:
             _err(errors, where, "TPUJOB_FLIGHT_DIR without an enabled "
                  "TPUJOB_FLIGHT_RING records nothing — set a ring size "
                  ">= 1 or drop the dir")
+
+
+def _check_autoscale(errors, where: str, c: dict) -> None:
+    """A manifest carrying elastic-serving env must be COHERENT offline —
+    same contract as the spec/flight checks: a controller that dies at
+    startup on min > max (or silently never brownouts because a stage
+    name is typo'd) only shows up during the first overload, which is
+    exactly when it must work. Min/max must be integers >= 1 with
+    min <= max, cooldowns positive numbers, and every brownout stage a
+    name serve/autoscale.py knows (lazy import, as with the tenant
+    check)."""
+    env = {e.get("name"): e for e in c.get("env", [])}
+    a_min = env.get("TPUJOB_AUTOSCALE_MIN")
+    a_max = env.get("TPUJOB_AUTOSCALE_MAX")
+    keys = [k for k in env if k and k.startswith("TPUJOB_AUTOSCALE_")]
+    if not keys:
+        return
+    min_val = max_val = None
+    if a_min is not None:
+        raw = (a_min.get("value") or "").strip()
+        if not raw.isdigit() or int(raw) < 1:
+            _err(errors, where, f"TPUJOB_AUTOSCALE_MIN {raw!r} must be "
+                 "an integer >= 1")
+        else:
+            min_val = int(raw)
+    if a_max is None:
+        _err(errors, where, "autoscale env without TPUJOB_AUTOSCALE_MAX "
+             "— the controller has no ceiling to scale toward")
+    else:
+        raw = (a_max.get("value") or "").strip()
+        if not raw.isdigit() or int(raw) < 1:
+            _err(errors, where, f"TPUJOB_AUTOSCALE_MAX {raw!r} must be "
+                 "an integer >= 1")
+        else:
+            max_val = int(raw)
+    if min_val is not None and max_val is not None and min_val > max_val:
+        _err(errors, where, f"TPUJOB_AUTOSCALE_MIN ({min_val}) > "
+             f"TPUJOB_AUTOSCALE_MAX ({max_val})")
+    for key in ("TPUJOB_AUTOSCALE_UP_COOLDOWN_S",
+                "TPUJOB_AUTOSCALE_DOWN_COOLDOWN_S"):
+        e = env.get(key)
+        if e is None:
+            continue
+        raw = (e.get("value") or "").strip()
+        try:
+            ok = float(raw) > 0
+        except ValueError:
+            ok = False
+        if not ok:
+            _err(errors, where, f"{key} {raw!r} must be a positive "
+                 "number of seconds")
+    brown = env.get("TPUJOB_AUTOSCALE_BROWNOUT")
+    if brown is not None:
+        raw = (brown.get("value") or "").strip()
+        if not raw:
+            _err(errors, where, "TPUJOB_AUTOSCALE_BROWNOUT is empty")
+        else:
+            from k8s_distributed_deeplearning_tpu.serve.autoscale import (
+                BROWNOUT_STAGE_NAMES)
+            for stage in raw.split(","):
+                if stage.strip() not in BROWNOUT_STAGE_NAMES:
+                    _err(errors, where,
+                         f"TPUJOB_AUTOSCALE_BROWNOUT stage "
+                         f"{stage.strip()!r} is not a known brownout "
+                         f"stage ({list(BROWNOUT_STAGE_NAMES)})")
 
 
 _PRESTOP_SLEEP = re.compile(r"\bsleep\s+(\d+)\b")

@@ -163,6 +163,18 @@ class Kubectl:
         if rc:
             raise RuntimeError(f"kubectl apply failed: {err[-2000:]}")
 
+    def patch_job(self, name: str, namespace: str, patch: str) -> None:
+        """Merge-patch one Job by name — the autoscaler's parallelism
+        actuation (serve/autoscale.py). Raises :class:`OSError` on a
+        non-zero exit so the controller's actuation-failure accounting
+        (retry next round) catches it like any other I/O fault."""
+        rc, _, err = self._run_kubectl(
+            ["patch", "job", name, "-n", namespace, "--type", "merge",
+             "-p", patch])
+        if rc:
+            raise OSError(f"kubectl patch job {name} failed rc={rc}: "
+                          f"{err[-2000:]}")
+
     def delete_job(self, cfg: JobConfig) -> None:
         """Foreground-delete the gang's Job (pods gone before return);
         absent Job is fine (first reconcile after an external delete).

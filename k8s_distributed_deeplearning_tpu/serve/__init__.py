@@ -10,6 +10,10 @@ prefix trie shares pages into slots with zero device copies — with freed
 slots re-admitted in flight (Orca-style iteration scheduling). See
 :mod:`serve.engine` for the design contract.
 """
+from k8s_distributed_deeplearning_tpu.serve.autoscale import (
+    BROWNOUT_STAGE_NAMES, BrownoutStage, EngineFactoryBackend,
+    FleetController, K8sParallelismBackend, LocalProcessBackend,
+    default_brownout_stages)
 from k8s_distributed_deeplearning_tpu.serve.engine import ServeEngine
 from k8s_distributed_deeplearning_tpu.serve.gateway import ServeGateway
 from k8s_distributed_deeplearning_tpu.serve.page_pool import PagePool
@@ -26,4 +30,7 @@ __all__ = ["ServeEngine", "ServeGateway", "Request", "RequestOutput",
            "SamplingParams", "RequestQueue", "QueueFull", "EngineDraining",
            "PagePool", "PrefixCache", "TenantConfig", "TenantScheduler",
            "DEFAULT_TENANT", "load_tenants", "ReplicaServer",
-           "ReplicaClient", "discover_replica_clients"]
+           "ReplicaClient", "discover_replica_clients",
+           "FleetController", "BrownoutStage", "BROWNOUT_STAGE_NAMES",
+           "default_brownout_stages", "EngineFactoryBackend",
+           "LocalProcessBackend", "K8sParallelismBackend"]

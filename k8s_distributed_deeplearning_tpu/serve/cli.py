@@ -86,6 +86,50 @@ def main(argv: list[str] | None = None) -> int:
                          "fleet from heartbeat files carrying "
                          "metrics_addr (written by replica-servers "
                          "started with --heartbeat-dir DIR)")
+    ap.add_argument("--autoscale", action="store_true",
+                    help="run the SLO-driven fleet controller "
+                         "(serve/autoscale.py) over the gateway: scale "
+                         "the replica set between --autoscale-min and "
+                         "--autoscale-max on fast-window SLO burn / "
+                         "queue pressure (drain-safe scale-down, zero "
+                         "lost requests) and walk the reversible "
+                         "brownout ladder at max scale")
+    ap.add_argument("--autoscale-min", type=int, default=1,
+                    help="elastic floor: never drain below this many "
+                         "replicas")
+    ap.add_argument("--autoscale-max", type=int, default=4,
+                    help="elastic ceiling: at this many replicas, "
+                         "sustained overload escalates the brownout "
+                         "ladder instead of adding capacity")
+    ap.add_argument("--autoscale-interval-s", type=float, default=0.5,
+                    metavar="S",
+                    help="minimum seconds between control rounds")
+    ap.add_argument("--autoscale-up-cooldown-s", type=float, default=2.0,
+                    metavar="S",
+                    help="minimum seconds between scale-up (or brownout "
+                         "escalation) actuations")
+    ap.add_argument("--autoscale-down-cooldown-s", type=float,
+                    default=5.0, metavar="S",
+                    help="minimum seconds between scale-down (or "
+                         "brownout de-escalation) actuations")
+    ap.add_argument("--autoscale-brownout", default=None, metavar="LIST",
+                    help="comma-separated brownout ladder stages in "
+                         "escalation order (default: shed_batch,"
+                         "no_hedge,tight_admission)")
+    ap.add_argument("--autoscale-k8s-job", default=None, metavar="NAME",
+                    help="actuate by patching this Indexed replica "
+                         "Job's parallelism through kubectl instead of "
+                         "spawning local processes (the rendered "
+                         "gateway role passes this)")
+    ap.add_argument("--autoscale-k8s-namespace", default="default",
+                    help="namespace of --autoscale-k8s-job")
+    ap.add_argument("--autoscale-endpoint-template", default=None,
+                    metavar="FMT",
+                    help="host:port format string with an {i} "
+                         "completion-index placeholder — how the k8s "
+                         "backend names the endpoint of a freshly "
+                         "scaled-up replica pod (Indexed-Job DNS is "
+                         "deterministic)")
     ap.add_argument("--requests", type=int, default=32)
     ap.add_argument("--max-queue", type=int, default=None,
                     help="admission queue bound (default: number of "
@@ -238,6 +282,43 @@ def main(argv: list[str] | None = None) -> int:
     if args.flight_dir is not None and not args.flight_ring:
         ap.error("--flight-dir requires --flight-ring >= 1 (there is "
                  "nothing to dump with the recorder off)")
+    if args.autoscale:
+        if args.replica_server:
+            ap.error("--autoscale runs gateway-side; a replica-server "
+                     "is the thing being scaled")
+        if args.autoscale_min < 1:
+            ap.error(f"--autoscale-min must be >= 1, got "
+                     f"{args.autoscale_min}")
+        if args.autoscale_max < args.autoscale_min:
+            ap.error(f"--autoscale-min ({args.autoscale_min}) must be "
+                     f"<= --autoscale-max ({args.autoscale_max})")
+        if args.autoscale_up_cooldown_s <= 0 \
+                or args.autoscale_down_cooldown_s <= 0:
+            ap.error("autoscale cooldowns must be > 0")
+        if args.autoscale_brownout is not None:
+            # Literal copy of serve.autoscale.BROWNOUT_STAGE_NAMES so a
+            # typo dies with usage text before the heavy imports; a
+            # parity test keeps the two tuples identical.
+            known = ("shed_batch", "no_hedge", "tight_admission")
+            for stage in args.autoscale_brownout.split(","):
+                if stage.strip() not in known:
+                    ap.error(f"--autoscale-brownout stage "
+                             f"{stage.strip()!r} is not one of {known}")
+        if args.autoscale_k8s_job is not None and not remote:
+            ap.error("--autoscale-k8s-job needs the remote gateway "
+                     "(--replica-endpoints/--replica-discovery-dir): "
+                     "the k8s backend scales replica-server pods")
+        if args.replica_endpoints is not None \
+                and args.autoscale_k8s_job is None:
+            ap.error("--autoscale over a static --replica-endpoints "
+                     "list has nothing to start/stop replicas with; "
+                     "pass --autoscale-k8s-job, or use "
+                     "--replica-discovery-dir for the local process "
+                     "backend")
+    elif args.autoscale_k8s_job is not None \
+            or args.autoscale_endpoint_template is not None:
+        ap.error("--autoscale-k8s-job/--autoscale-endpoint-template "
+                 "only make sense with --autoscale")
 
     import signal
 
@@ -340,7 +421,8 @@ def main(argv: list[str] | None = None) -> int:
             request_log=logger, stats=stats,
             draft_model=draft_model, draft_params=draft_params,
             spec_k=args.spec_k, flight=flight,
-            replica_id=f"r{i}" if args.replicas > 1 else None)
+            replica_id=(f"r{i}" if args.replicas > 1 or args.autoscale
+                        else None))
         for i in range(args.replicas)]
     engine = engines[0] if engines else None
     clients = None
@@ -369,7 +451,9 @@ def main(argv: list[str] | None = None) -> int:
         gateway = ServeGateway(clients, stats=stats, logger=logger,
                                hedge_after_s=args.hedge_after_s,
                                flight=flight)
-    elif args.replicas > 1:
+    elif args.replicas > 1 or args.autoscale:
+        # --autoscale forces the gateway even at one replica: the
+        # controller actuates through its dynamic membership.
         gateway = ServeGateway(engines, stats=stats, logger=logger,
                                hedge_after_s=args.hedge_after_s,
                                flight=flight)
@@ -377,6 +461,95 @@ def main(argv: list[str] | None = None) -> int:
     # What the probes report on: remote mode watches the clients' cached
     # replica states, local mode the engines themselves.
     status_objs = clients if clients is not None else engines
+
+    controller = None
+    autoscale_backend = None
+    slo = None
+    if args.autoscale:
+        import time as _time_mod
+
+        from k8s_distributed_deeplearning_tpu.serve.autoscale import (
+            EngineFactoryBackend, FleetController, K8sParallelismBackend,
+            LocalProcessBackend, default_brownout_stages,
+            heartbeat_discoverer)
+        from k8s_distributed_deeplearning_tpu.telemetry.slo import (
+            SLOEngine, SLOTarget, objectives_from_tenants)
+        objectives = (objectives_from_tenants(tenant_cfgs)
+                      if tenant_cfgs is not None else {})
+        if not objectives:
+            # No tenant slo blocks: synthesize a 99%-over-60s objective
+            # per tenant (fast window = 5s) so the burn signal is live
+            # at demo timescales instead of the 1h production default.
+            ids = ([c.tenant_id for c in tenant_cfgs]
+                   if tenant_cfgs is not None else ["default"])
+            objectives = {tid: SLOTarget(availability=0.99,
+                                         window_s=60.0) for tid in ids}
+        # Same monotonic clock as the controller: observe() stamps and
+        # evaluate() windows must live on one timeline.
+        slo = SLOEngine(objectives, emit=logger.emit,
+                        clock=_time_mod.monotonic)
+        if args.autoscale_k8s_job is not None:
+            from k8s_distributed_deeplearning_tpu.launch.watch import (
+                Kubectl)
+            autoscale_backend = K8sParallelismBackend(
+                Kubectl(), args.autoscale_k8s_job,
+                args.autoscale_k8s_namespace,
+                initial_replicas=len(clients),
+                endpoint_template=args.autoscale_endpoint_template,
+                client_kwargs=dict(stats=stats, logger=logger,
+                                   flight=flight))
+        elif remote:
+            autoscale_backend = LocalProcessBackend(
+                args.replica_discovery_dir, preset=args.preset,
+                slots=args.slots,
+                client_kwargs=dict(stats=stats, logger=logger,
+                                   flight=flight))
+        else:
+            def _make_engine():
+                return ServeEngine(
+                    model, params, num_slots=args.slots,
+                    max_queue=args.max_queue or args.requests,
+                    eos_id=args.eos_id, tracer=tracer,
+                    tenants=tenant_cfgs,
+                    prefill_chunk_tokens=args.prefill_chunk_tokens
+                    or None,
+                    prefix_cache_mb=args.prefix_cache_mb or None,
+                    kv_pool_pages=args.kv_pool_pages or None,
+                    request_trace_sample=args.request_trace_sample,
+                    request_log=logger, stats=stats,
+                    draft_model=draft_model,
+                    draft_params=draft_params,
+                    spec_k=args.spec_k, flight=flight)
+            autoscale_backend = EngineFactoryBackend(_make_engine)
+        discover = None
+        if (args.autoscale_k8s_job is not None
+                and args.replica_discovery_dir is not None):
+            # Async membership: pods scaled up by the Job patch join
+            # when their heartbeat beacon lands in the shared dir.
+            discover = heartbeat_discoverer(
+                args.replica_discovery_dir,
+                client_kwargs=dict(stats=stats, logger=logger,
+                                   flight=flight))
+        stages = None
+        if args.autoscale_brownout is not None:
+            stages = default_brownout_stages(tuple(
+                s.strip() for s in args.autoscale_brownout.split(",")))
+        controller = FleetController(
+            gateway, autoscale_backend, slo=slo,
+            min_replicas=args.autoscale_min,
+            max_replicas=args.autoscale_max,
+            interval_s=args.autoscale_interval_s,
+            up_cooldown_s=args.autoscale_up_cooldown_s,
+            down_cooldown_s=args.autoscale_down_cooldown_s,
+            brownout_stages=stages, discover=discover, logger=logger)
+
+    def _fleet_engines():
+        # Membership is dynamic under --autoscale: resolve the probe
+        # targets per call instead of freezing the startup list.
+        if controller is not None:
+            return [gateway.replica_engine(rid)
+                    for rid in gateway.replica_ids()]
+        return status_objs
 
     # SIGTERM → cooperative drain → exit 0: the k8s eviction handshake.
     # The handler only flips drain mode (stop admitting); the serving
@@ -392,10 +565,11 @@ def main(argv: list[str] | None = None) -> int:
         # interrupted — before drain mode starts changing it.
         if flight is not None:
             flight.dump("sigterm")
-        if clients is not None:
-            # Remote fleet: cooperative drain THROUGH the gateway so
-            # queued work migrates between replicas instead of dying
-            # with this process's view of them.
+        if clients is not None or controller is not None:
+            # Remote or elastic fleet: cooperative drain THROUGH the
+            # gateway so queued work migrates between replicas instead
+            # of dying with this process's view of them (under
+            # --autoscale the startup `engines` list is stale anyway).
             for rid in list(gateway.snapshot()["replicas"]):
                 gateway.drain_replica(rid)
         else:
@@ -450,6 +624,8 @@ def main(argv: list[str] | None = None) -> int:
         bridge.serving_collector(registry, stats)
         if gateway is not None:
             bridge.gateway_collector(registry, gateway)
+            if controller is not None:
+                bridge.autoscale_collector(registry, controller)
         else:
             # Per-tenant labeled gauges are per-scheduler; with replicas
             # each engine has its own and the labels would collide.
@@ -458,13 +634,14 @@ def main(argv: list[str] | None = None) -> int:
             registry, port=args.metrics_port,
             tracer=tracer if args.debug_dir is not None else None,
             profile_dir=args.debug_dir, flight=flight,
-            healthz=lambda: _drain_status(status_objs),
+            healthz=lambda: _drain_status(_fleet_engines()),
             # Readiness splits from liveness: 503 the moment a drain
             # starts (stop routing here) while /healthz stays 200 (do
             # not restart a draining pod).
             readyz=lambda: {
-                "ready": not any(e.draining for e in status_objs),
-                **_drain_status(status_objs)}).start()
+                "ready": not any(e.draining
+                                 for e in _fleet_engines()),
+                **_drain_status(_fleet_engines())}).start()
     shared = rng.integers(0, cfg.vocab_size, size=args.shared_prefix_len)
     if engine is not None:
         tenant_ids = engine.queue.tenant_ids()
@@ -476,20 +653,24 @@ def main(argv: list[str] | None = None) -> int:
         tenant_ids = ["default"]
     from collections import deque
     feed = deque()
+    tenant_of = {}          # request_id -> tenant, for the SLO feed
     for i in range(args.requests):
         prompt = rng.integers(0, cfg.vocab_size,
                               size=int(rng.integers(p_lo, p_hi + 1)))
         prompt = np.concatenate([shared, prompt])
-        feed.append(Request(
+        req = Request(
             prompt=prompt.astype(np.int32),
             max_new_tokens=int(rng.integers(o_lo, o_hi + 1)),
             sampling=sampling, seed=args.seed + i,
-            tenant=tenant_ids[i % len(tenant_ids)]))
+            tenant=tenant_ids[i % len(tenant_ids)])
+        tenant_of[req.request_id] = req.tenant
+        feed.append(req)
 
     # Drive iteration-by-iteration so completions stream out as they
     # happen — the same loop a network front-end would run. Requests are
     # fed under back-pressure: a tenant whose bounded queue is full sheds
     # (logged) and the front end retries it after the next iteration.
+    slo_finished = {}       # tenant -> cumulative {reason: count}
     while feed or front.busy():
         if drain_requested and feed:
             feed.clear()        # draining: the unsubmitted tail is shed
@@ -511,6 +692,17 @@ def main(argv: list[str] | None = None) -> int:
                         ttft_ms=(round(out.ttft_s * 1e3, 3)
                                  if out.ttft_s is not None else None),
                         latency_ms=round(out.latency_s * 1e3, 3))
+            if controller is not None:
+                by = slo_finished.setdefault(
+                    tenant_of.get(out.request_id, "default"), {})
+                by[out.finish_reason] = by.get(out.finish_reason,
+                                               0) + 1
+        if controller is not None and not drain_requested:
+            # The serving loop IS the scrape cadence: feed cumulative
+            # finish counts to the burn windows, then give the control
+            # loop its (self-rate-limited) slice.
+            slo.observe(finished=slo_finished)
+            controller.maybe_round()
     if drain_requested:
         for e in engines:
             logger.emit("replica_drained",
@@ -519,6 +711,11 @@ def main(argv: list[str] | None = None) -> int:
     logger.emit("serve_summary", num_slots=args.slots,
                 preset=args.preset, replicas=args.replicas,
                 **stats.summary())
+    if controller is not None:
+        logger.emit("autoscale_summary", **controller.snapshot())
+        reap = getattr(autoscale_backend, "reap_all", None)
+        if reap is not None:
+            reap()               # LocalProcessBackend child teardown
     if args.spec_k:
         summ = stats.summary()
         logger.emit("spec_summary", draft=args.draft_model,

@@ -46,6 +46,13 @@ Mechanisms (each mirrors a discipline the repo already has):
   requests and migrates its in-flight work (engine reason
   ``migrated``), then the replica finishes empty and reports
   ``drained`` — the SIGTERM/preStop handshake for rolling updates.
+- **Dynamic membership** — :meth:`add_replica` folds a new engine into
+  the running gateway (breaker/health state created at runtime, next
+  submit can route to it); :meth:`remove_replica` retires one through
+  the drain+migrate path. The elastic surface serve/autoscale.py
+  drives, along with two brownout levers: ``shed_classes`` (tenant
+  priority classes refused at the door) and ``max_live_requests``
+  (admission cap), both reversible attributes.
 
 Chaos surface: the ``gateway_dispatch`` fault site fires before each
 replica's step with ``step=<replica index>``, so a step-scoped plan
@@ -215,6 +222,14 @@ class ServeGateway:
             h = _Replica(eng, rid, i, probe_backoff_s)
             self._replicas.append(h)
             self._by_rid[rid] = h
+        # Replica indices are MONOTONIC across the gateway's lifetime
+        # (never reused after remove_replica) so a step-scoped
+        # gateway_dispatch fault plan keeps naming the same replica.
+        self._next_index = len(self._replicas)
+        # Brownout levers (serve/autoscale.py): tenant classes shed at
+        # the door, and a cap on concurrently admitted client requests.
+        self.shed_classes: frozenset[str] = frozenset()
+        self.max_live_requests: int | None = None
         self._live: dict[str, _GwRequest] = {}     # request_id -> record
         self._completed: list[RequestOutput] = []
 
@@ -229,6 +244,17 @@ class ServeGateway:
         if req.request_id in self._live:
             raise ValueError(
                 f"request {req.request_id} is already live in the gateway")
+        if (self.max_live_requests is not None
+                and len(self._live) >= self.max_live_requests):
+            raise QueueFull(
+                f"gateway admission tightened to {self.max_live_requests} "
+                f"live requests (brownout) — retry after completions")
+        if self.shed_classes:
+            klass = self._tenant_class(req.tenant)
+            if klass in self.shed_classes:
+                raise QueueFull(
+                    f"tenant {req.tenant!r} class {klass!r} is shed "
+                    f"(brownout) — retry after the fleet recovers")
         g = _GwRequest(req, self._clock())
         exclude: set[str] = set()
         while True:
@@ -331,6 +357,79 @@ class ServeGateway:
                 break
         return outputs
 
+    def add_replica(self, engine, *, rid: str | None = None) -> str:
+        """Fold a new replica into the running gateway: breaker and
+        health state are created fresh (CLOSED, zero failures) and the
+        very next :meth:`submit`/:meth:`step` can route to it. Returns
+        the replica id. Raises ValueError on a duplicate id."""
+        if rid is None:
+            rid = getattr(engine, "replica_id", None)
+        index = self._next_index
+        if rid is None:
+            rid = f"r{index}"
+        if getattr(engine, "replica_id", None) is None:
+            engine.replica_id = rid       # request_trace replica= field
+        if rid in self._by_rid:
+            raise ValueError(f"duplicate replica_id {rid!r}")
+        self._next_index += 1
+        h = _Replica(engine, rid, index, self.probe_backoff_s)
+        self._replicas.append(h)
+        self._by_rid[rid] = h
+        if self.logger is not None:
+            self.logger.emit("gateway_replica_added", replica=rid,
+                             replicas=len(self._replicas))
+        return rid
+
+    def remove_replica(self, rid: str, *, force: bool = False) -> None:
+        """Retire one replica from the gateway: drain it (the
+        migration-backed path — queued and in-flight work moves to peers
+        with its emitted-token cursor, zero lost requests), then drop its
+        breaker/health state. Raises ValueError for an unknown id or the
+        last replica, and RuntimeError if the engine has not finished
+        draining yet (call again after more steps; ``force=True`` skips
+        both the last-replica and the drained checks — shutdown paths)."""
+        h = self._by_rid.get(rid)
+        if h is None:
+            raise ValueError(
+                f"unknown replica {rid!r} (have {sorted(self._by_rid)})")
+        if len(self._replicas) <= 1 and not force:
+            raise ValueError(
+                f"refusing to remove the last replica {rid!r} "
+                f"(force=True to tear the gateway down)")
+        if not h.draining:
+            self.drain_replica(rid)
+        if not h.engine.drained and not force:
+            raise RuntimeError(
+                f"replica {rid!r} is still draining — step the gateway "
+                f"until its engine reports drained, then remove")
+        self._replicas.remove(h)
+        del self._by_rid[rid]
+        if self.logger is not None:
+            self.logger.emit("gateway_replica_removed", replica=rid,
+                             replicas=len(self._replicas))
+
+    def replica_engine(self, rid: str):
+        """The engine behind *rid* (autoscale backends stop it after the
+        gateway has retired the membership)."""
+        return self._by_rid[rid].engine
+
+    def replica_ids(self) -> list[str]:
+        return [h.rid for h in self._replicas]
+
+    def _tenant_class(self, tenant: str) -> str | None:
+        """Priority class of *tenant* per the first replica scheduler
+        that knows it (TenantScheduler.priority_of); None when no
+        scheduler claims the tenant (stub engines, plain-list queues)."""
+        for h in self._replicas:
+            pr = getattr(getattr(h.engine, "queue", None),
+                         "priority_of", None)
+            if pr is None:
+                continue
+            klass = pr(tenant)
+            if klass is not None:
+                return klass
+        return None
+
     def drain_replica(self, rid: str) -> None:
         """Cooperatively drain one replica: flush its queued requests and
         migrate them AND its in-flight work to peers, leaving it to
@@ -386,6 +485,7 @@ class ServeGateway:
                 "consecutive_failures": h.consecutive,
                 "health": round(self._health_score(h), 4),
                 "load": h.engine.load(),
+                "slots": getattr(h.engine, "num_slots", 0),
                 "draining": h.draining,
                 "drained": h.engine.drained,
                 "next_probe_in_s": (round(max(0.0, h.next_probe_t - now), 3)

@@ -448,6 +448,10 @@ class ReplicaServer:
             self._thread.join(timeout=5.0)
             self._thread = None
         self.exporter.stop()
+        if self._hb is not None:
+            # Clean shutdown removes the beacon: a deliberately-gone
+            # replica must never be rediscovered as a live endpoint.
+            self._hb.remove()
 
 
 # --------------------------------------------------------------- client
@@ -795,11 +799,15 @@ class ReplicaClient:
         return []
 
 
-def discover_replica_clients(heartbeat_dir: str,
+def discover_replica_clients(heartbeat_dir: str, *,
+                             stale_after_s: float | None = None,
                              **kwargs) -> list[ReplicaClient]:
     """One :class:`ReplicaClient` per ``metrics_addr`` advertised in
     *heartbeat_dir* (the :class:`ReplicaServer` heartbeat extra) — the
-    no-static-config path to a remote gateway fleet. *kwargs* forward
-    to every client (shared stats/logger, timeouts)."""
+    no-static-config path to a remote gateway fleet. *stale_after_s*
+    drops beacons older than that age (a crashed replica's leftover file
+    is not an endpoint); *kwargs* forward to every client (shared
+    stats/logger, timeouts)."""
     return [ReplicaClient(ep, **kwargs)
-            for ep in discover_endpoints(heartbeat_dir)]
+            for ep in discover_endpoints(heartbeat_dir,
+                                         stale_after_s=stale_after_s)]

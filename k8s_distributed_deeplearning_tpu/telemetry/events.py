@@ -79,6 +79,31 @@ EVENTS: dict[str, str] = {
     "transport_reconnect": "a replica's token stream resumed from its "
                            "emitted-token cursor after one or more failed "
                            "polls (replica and cursor positions attached)",
+    "gateway_replica_added": "dynamic membership: a replica joined the "
+                             "running gateway (breaker state created; "
+                             "next submit can route to it)",
+    "gateway_replica_removed": "dynamic membership: a drained replica was "
+                               "retired from the gateway (breaker state "
+                               "dropped with it)",
+    "autoscale_up": "the fleet controller added a replica: sustained "
+                    "fast-window SLO burn or queue pressure (burn rate, "
+                    "load per slot, desired/actual attached)",
+    "autoscale_down": "the fleet controller drained an idle replica out "
+                      "(migration-backed, zero lost requests; victim and "
+                      "desired/actual attached)",
+    "autoscale_replace": "the fleet controller is replacing a replica "
+                         "whose composite health stayed under the floor "
+                         "(or breaker stayed open) — drain out, fresh "
+                         "replica in",
+    "autoscale_brownout": "at max_replicas with burn still rising the "
+                          "controller escalated the reversible "
+                          "degradation ladder (level and stage attached)",
+    "autoscale_restored": "the brownout ladder fully unwound — burn "
+                          "cleared and every degradation lever is back "
+                          "to normal",
+    "autoscale_summary": "end-of-run fleet controller snapshot (rounds, "
+                         "decision counts, actuation failures, final "
+                         "desired/actual replicas)",
 }
 
 _SNAKE = re.compile(r"^[a-z][a-z0-9_]*$")

@@ -176,13 +176,27 @@ def parse_exposition(text: str) -> dict[str, Family]:
 
 # ----------------------------------------------------------------- scraper
 
-def discover_endpoints(heartbeat_dir: str) -> list[str]:
+def discover_endpoints(heartbeat_dir: str, *,
+                       stale_after_s: float | None = None,
+                       now: float | None = None) -> list[str]:
     """Endpoints advertised by heartbeat records: any rank whose writer
     passed ``metrics_addr="host:port"`` as a beat extra (the discovery
-    path for replicas behind no static config)."""
-    addrs = {str(rec["metrics_addr"])
-             for rec in hb.read_heartbeats(heartbeat_dir)
-             if rec.get("metrics_addr")}
+    path for replicas behind no static config).
+
+    *stale_after_s* (same age logic as :func:`heartbeat.detect_stalls`)
+    drops beacons older than that many seconds — a replica that died
+    without removing its file is never handed back as a live endpoint.
+    None keeps the historical behaviour (every beacon counts)."""
+    if now is None:
+        now = time.time()
+    addrs = set()
+    for rec in hb.read_heartbeats(heartbeat_dir):
+        if not rec.get("metrics_addr"):
+            continue
+        if (stale_after_s is not None
+                and now - float(rec["ts"]) > stale_after_s):
+            continue
+        addrs.add(str(rec["metrics_addr"]))
     return sorted(addrs)
 
 

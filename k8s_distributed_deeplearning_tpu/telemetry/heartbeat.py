@@ -61,6 +61,16 @@ class HeartbeatWriter:
                 except Exception:
                     pass
 
+    def remove(self) -> None:
+        """Delete this rank's beacon — the clean-shutdown half of the
+        liveness contract, so discovery never hands a deliberately-gone
+        rank back as an endpoint. Unclean exits leave the file behind;
+        readers age it out via their ``stale_after_s`` filters."""
+        try:
+            os.unlink(_rank_path(self.directory, self.rank))
+        except OSError:
+            pass
+
 
 @dataclasses.dataclass(frozen=True)
 class StallReport:

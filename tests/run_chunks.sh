@@ -74,9 +74,13 @@ CHUNKS[flight]="tests/test_flight.py"
 # real engines behind ReplicaServer threads — its own chunk, and the slow
 # marker holds the subprocess SIGTERM-drain e2e (three CLI processes).
 CHUNKS[transport]="tests/test_transport.py"
+# graftpilot (serve/autoscale.py fleet controller): fake-clock chaos matrix
+# runs jax-free, but the bit-identical mid-decode removal case compiles a
+# real multi-replica fleet — its own chunk so gateway stays under timeout.
+CHUNKS[autoscale]="tests/test_autoscale.py"
 CHUNKS[slow1]="tests/test_train_e2e.py tests/test_multiprocess.py"
 CHUNKS[slow2]="tests/test_multihost_train.py tests/test_multihost_llama.py tests/test_train_zoo.py"
-ORDER=(lint core parallel1 parallel2 moe train llama deploy serve sched paged faults graftscope fleet gateway spec flight transport slow1 slow2)
+ORDER=(lint core parallel1 parallel2 moe train llama deploy serve sched paged faults graftscope fleet gateway spec flight transport autoscale slow1 slow2)
 
 # --- completeness check: every tests/test_*.py in EXACTLY one chunk ------
 # ...and every declared chunk actually in ORDER: a chunk missing from the

@@ -399,3 +399,76 @@ def test_gateway_endpoint_drift_and_headless_service_are_caught():
                     and d["metadata"]["name"].endswith("-replica"))]
     errs = validate.validate(docs)
     assert any("no headless Service named" in e for e in errs)
+
+
+def test_autoscale_env_and_gateway_flags_render():
+    """autoscale_* config renders twice: as TPUJOB_AUTOSCALE_* env (the
+    offline-checkable record) and as --autoscale* flags on the gateway
+    command (what actually starts the fleet controller, pointed at the
+    replica Job it will patch)."""
+    from k8s_distributed_deeplearning_tpu.launch import validate
+
+    docs = _serving_docs(name="svc", namespace="ns", metrics_port=9200,
+                         autoscale_min=2, autoscale_max=5,
+                         autoscale_up_cooldown_s=5,
+                         autoscale_down_cooldown_s=30,
+                         autoscale_brownout="shed_batch,no_hedge")
+    assert validate.validate(docs) == []
+    gw = next(d for d in docs if d["kind"] == "Job" and
+              (d["metadata"].get("labels") or {}).get("role")
+              == "serve-gateway")
+    c = gw["spec"]["template"]["spec"]["containers"][0]
+    env = {e["name"]: e["value"] for e in c["env"]}
+    assert env["TPUJOB_AUTOSCALE_MIN"] == "2"
+    assert env["TPUJOB_AUTOSCALE_MAX"] == "5"
+    assert env["TPUJOB_AUTOSCALE_UP_COOLDOWN_S"] == "5"
+    assert env["TPUJOB_AUTOSCALE_DOWN_COOLDOWN_S"] == "30"
+    assert env["TPUJOB_AUTOSCALE_BROWNOUT"] == "shed_batch,no_hedge"
+    cmd = c["command"]
+    assert "--autoscale" in cmd
+    for flag, val in (("--autoscale-min", "2"), ("--autoscale-max", "5"),
+                      ("--autoscale-k8s-job", "svc-replica"),
+                      ("--autoscale-k8s-namespace", "ns"),
+                      ("--autoscale-up-cooldown-s", "5"),
+                      ("--autoscale-down-cooldown-s", "30"),
+                      ("--autoscale-brownout", "shed_batch,no_hedge")):
+        assert cmd[cmd.index(flag) + 1] == val, flag
+    assert cmd[cmd.index("--autoscale-endpoint-template") + 1] == \
+        "svc-replica-{i}.svc-replica.ns:9200"
+    # Without autoscale_max the gateway stays static: no controller
+    # flags, no ceiling-less env.
+    docs = _serving_docs(name="svc")
+    gw = next(d for d in docs if d["kind"] == "Job" and
+              (d["metadata"].get("labels") or {}).get("role")
+              == "serve-gateway")
+    c = gw["spec"]["template"]["spec"]["containers"][0]
+    assert "--autoscale" not in c["command"]
+    assert not any(e["name"].startswith("TPUJOB_AUTOSCALE_")
+                   for e in c["env"])
+
+
+def test_autoscale_validation_catches_incoherent_env():
+    """The controller's startup invariants, checked offline: a MIN
+    without a MAX has no ceiling to scale toward; min > max dies at
+    construction; a zero cooldown removes flap damping; a typo'd
+    brownout stage silently never sheds. All of these pass the k8s
+    schema — only the semantic check catches them before apply."""
+    from k8s_distributed_deeplearning_tpu.launch import validate
+
+    errs = validate.validate(_serving_docs(autoscale_min=2))
+    assert any("without TPUJOB_AUTOSCALE_MAX" in e for e in errs)
+    errs = validate.validate(_serving_docs(autoscale_min=5,
+                                           autoscale_max=2))
+    assert any("TPUJOB_AUTOSCALE_MIN (5) > TPUJOB_AUTOSCALE_MAX (2)"
+               in e for e in errs)
+    errs = validate.validate(_serving_docs(autoscale_max=0))
+    assert any("TPUJOB_AUTOSCALE_MAX" in e and "integer >= 1" in e
+               for e in errs)
+    errs = validate.validate(_serving_docs(autoscale_max=4,
+                                           autoscale_up_cooldown_s=0))
+    assert any("TPUJOB_AUTOSCALE_UP_COOLDOWN_S" in e and
+               "positive" in e for e in errs)
+    errs = validate.validate(_serving_docs(
+        autoscale_max=4, autoscale_brownout="shed_batch,warp_speed"))
+    assert any("'warp_speed' is not a known brownout stage" in e
+               for e in errs)
