@@ -211,13 +211,26 @@ def _jit_in_loop(project: Project, mod: ModuleInfo) -> list[Finding]:
 
 def _axis_universe(project: Project) -> set[str]:
     """Every axis name the tree DECLARES: Mesh axis tuples, ``axis_names``
-    accessors, shard_map/pmap specs, PartitionSpec literals, and
-    ``axis_name=...`` parameter defaults. Collective call sites are
-    deliberately NOT part of the universe — a typo there must not
+    accessors, shard_map/pmap specs, PartitionSpec literals,
+    ``axis_name=...`` parameter defaults, and module-level
+    ``SOMETHING_AXIS = "name"`` constants (the serving shard_map axis
+    idiom — sharding.SERVE_TP_AXIS flows into collectives as a variable,
+    but downstream code spells the literal too). Collective call sites
+    are deliberately NOT part of the universe — a typo there must not
     self-validate."""
     axes: set[str] = set()
     for mod in project.modules:
         for n in ast.walk(mod.tree):
+            if isinstance(n, ast.Assign):
+                # Module/class-level axis-name constants: ALL_CAPS names
+                # ending in _AXIS bound to a string literal.
+                if (isinstance(n.value, ast.Constant)
+                        and isinstance(n.value.value, str)):
+                    for tgt in n.targets:
+                        if (isinstance(tgt, ast.Name)
+                                and tgt.id.isupper()
+                                and tgt.id.endswith("_AXIS")):
+                            axes.add(n.value.value)
             if isinstance(n, ast.Call):
                 tail = name_tail(n.func)
                 if tail == "Mesh":

@@ -160,6 +160,10 @@ class JobConfig:
     serve_replicas: int | None = None
     serve_preset: str = "tiny"       # model preset for both serving roles
     serve_slots: int | None = None   # per-replica decode slots (None = CLI default)
+    serve_tp: int | None = None      # tensor-parallel width per replica
+                                     # (graftmesh): the replica Job requests
+                                     # exactly this many chips and the CLI
+                                     # gets --tp; None = single-device
     # preStop sleep: delay SIGTERM by this many seconds so the endpoint/
     # gateway routing layer observes the pod leaving the ready set and
     # stops sending NEW requests before the drain starts (the classic

@@ -91,6 +91,13 @@ def main(argv: list[str] | None = None) -> int:
             "--serve-slots", type=int, default=d.serve_slots,
             help="decode slots per serving replica (default: the serve "
                  "CLI's own default)")
+        p.add_argument(
+            "--serve-tp", type=int, default=d.serve_tp,
+            help="tensor-parallel width per serving replica (graftmesh): "
+                 "each replica pod requests this many TPU chips and runs "
+                 "its decode programs under shard_map; validate checks "
+                 "head/MLP divisibility and per-shard pool fit offline "
+                 "(0 = single-device, no mesh)")
     parsers["render"].add_argument(
         "--apply", action="store_true",
         help="pipe the manifests into kubectl apply -f -")
@@ -138,7 +145,8 @@ def main(argv: list[str] | None = None) -> int:
                     pre_stop_sleep_s=args.pre_stop_sleep_s,
                     serve_replicas=args.serve_replicas,
                     serve_preset=args.serve_preset,
-                    serve_slots=args.serve_slots)
+                    serve_slots=args.serve_slots,
+                    serve_tp=args.serve_tp)
     docs = render.render_all(cfg)
     text = render.to_yaml(docs)
 

@@ -189,6 +189,13 @@ def _serving_chips(cfg: JobConfig) -> int:
     # Each serving replica is its own single-host slice: the pod claims
     # the whole topology's chips (no num_workers split — that divisor
     # belongs to the training gang, not the serving fleet).
+    # Tensor-parallel replicas (serve_tp) claim exactly their mesh width:
+    # the engine shards over the first tp devices, so requesting more
+    # would strand chips and requesting fewer would fail the ctor's
+    # device_count >= tp check at boot (validate.py flags the mismatch
+    # offline).
+    if cfg.serve_tp is not None:
+        return cfg.serve_tp
     if cfg.tpu_chips_per_worker is not None:
         return cfg.tpu_chips_per_worker
     chips = 1
@@ -206,6 +213,8 @@ def _serving_env(cfg: JobConfig) -> list[dict]:
         env.append({"name": "TPUJOB_FAULT_PLAN", "value": cfg.fault_plan})
     if cfg.tenants:
         env.append({"name": "TPUJOB_TENANTS", "value": cfg.tenants})
+    if cfg.serve_tp is not None:
+        env.append({"name": "TPUJOB_SERVE_TP", "value": str(cfg.serve_tp)})
     # Elastic serving (serve/autoscale.py): each knob renders
     # independently so a dangling half (min without max, an unknown
     # brownout stage) is VISIBLE in the manifest — validate.py flags it
@@ -309,6 +318,8 @@ def render_replica_job(cfg: JobConfig) -> dict:
              f" --advertise-host $(hostname -f)")
     if cfg.serve_slots is not None:
         serve += f" --slots {cfg.serve_slots}"
+    if cfg.serve_tp is not None:
+        serve += f" --tp {cfg.serve_tp}"
     if cfg.tenants:
         serve += f" --tenants '{cfg.tenants}'"
     if cfg.flight_ring is not None:

@@ -73,6 +73,7 @@ def _check_container(errors, where: str, c: dict) -> None:
     _check_tenants(errors, where, c)
     _check_fleet_endpoints(errors, where, c)
     _check_spec(errors, where, c)
+    _check_tp(errors, where, c)
     _check_flight(errors, where, c)
     _check_autoscale(errors, where, c)
 
@@ -210,6 +211,114 @@ def _check_spec(errors, where: str, c: dict) -> None:
         if not raw.isdigit() or int(raw) < 1:
             _err(errors, where, f"TPUJOB_SPEC_K {raw!r} must be an "
                  "integer >= 1")
+
+
+# Serving preset geometry, mirrored from the serve/cli.py --preset /
+# --draft-model recipes as (n_heads, n_kv_heads, head_dim, n_layers,
+# kv_itemsize): importing serve.cli here would drag jax into offline
+# validation, so the numbers are literal — tests/test_tp_serve.py pins
+# this table against the real preset configs so it cannot drift silently.
+_SERVE_PRESET_GEOM = {
+    "tiny": (4, 2, 16, 2, 4),       # config_tiny defaults, float32 KV
+    "small": (12, 4, 64, 12, 2),    # bfloat16 KV
+}
+_DRAFT_PRESET_GEOM = {
+    "micro": (2, 1),
+    "tiny": (4, 2),
+}
+
+_QTY_SUFFIX = (("Ki", 2 ** 10), ("Mi", 2 ** 20), ("Gi", 2 ** 30),
+               ("Ti", 2 ** 40), ("K", 10 ** 3), ("M", 10 ** 6),
+               ("G", 10 ** 9), ("T", 10 ** 12))
+
+
+def _qty_bytes(qty) -> int | None:
+    """Kubernetes resource quantity -> bytes (None when unparseable —
+    the quantity-syntax check already flagged malformed values)."""
+    s = str(qty)
+    for suf, mult in _QTY_SUFFIX:
+        if s.endswith(suf):
+            try:
+                return int(float(s[:-len(suf)]) * mult)
+            except ValueError:
+                return None
+    try:
+        return int(s)
+    except ValueError:
+        return None
+
+
+def _int_flag(cmd: str, flag: str, default: int) -> int:
+    m = re.search(rf"{re.escape(flag)}\s+(\d+)", cmd)
+    return int(m.group(1)) if m else default
+
+
+def _check_tp(errors, where: str, c: dict) -> None:
+    """A manifest carrying $TPUJOB_SERVE_TP must be launchable offline:
+    tp an integer >= 1; the pod's TPU chip limit exactly tp (the engine
+    meshes over the first tp devices — extra chips idle, fewer fail the
+    ServeEngine ctor's device_count >= tp check at boot); the preset's
+    attention geometry divisible by tp (mirrors the ctor's
+    head-divisibility errors) for both the target and any draft preset;
+    and the per-shard KV pool bytes within the container memory limit.
+    Same offline contract as the spec/tenant checks: a replica that dies
+    at startup wastes a scheduled multi-chip slice."""
+    env = {e.get("name"): e for e in c.get("env", [])}
+    tp_env = env.get("TPUJOB_SERVE_TP")
+    if tp_env is None:
+        return
+    raw = (tp_env.get("value") or "").strip()
+    if not raw.isdigit() or int(raw) < 1:
+        _err(errors, where,
+             f"TPUJOB_SERVE_TP {raw!r} must be an integer >= 1")
+        return
+    tp = int(raw)
+    chips = (c.get("resources", {}).get("limits") or {}).get("google.com/tpu")
+    if chips is not None and str(chips).isdigit() and int(chips) != tp:
+        _err(errors, where,
+             f"TPUJOB_SERVE_TP ({tp}) != google.com/tpu limit ({chips}) — "
+             "the tp mesh spans exactly tp chips; extra chips idle, fewer "
+             "fail the engine's device_count >= tp check at boot")
+    cmd = " ".join(str(x) for x in
+                   (c.get("command") or []) + (c.get("args") or []))
+    m = re.search(r"--preset\s+(\S+)", cmd)
+    preset = m.group(1) if m else "tiny"
+    geom = _SERVE_PRESET_GEOM.get(preset)
+    if geom is not None:
+        heads, kv, head_dim, layers, itemsize = geom
+        if heads % tp or kv % tp:
+            _err(errors, where,
+                 f"preset {preset!r} (n_heads={heads}, num_kv_heads={kv}) "
+                 f"is not divisible by TPUJOB_SERVE_TP ({tp}) — every "
+                 "shard must own whole attention/KV heads")
+        else:
+            slots = _int_flag(cmd, "--slots", 8)
+            max_seq = _int_flag(cmd, "--max-seq-len", 512)
+            pool = _int_flag(cmd, "--kv-pool-pages", 0)
+            page_tokens = 32            # engine default: min_bucket
+            blocks = -(-max_seq // page_tokens)
+            pages = (pool if pool > 0 else slots * blocks) + 1
+            per_shard = (pages * page_tokens * (kv // tp) * head_dim
+                         * itemsize * 2 * layers)
+            mem = _qty_bytes((c.get("resources", {}).get("limits") or {})
+                             .get("memory", ""))
+            if mem is not None and per_shard > mem:
+                _err(errors, where,
+                     f"per-shard KV pool (~{per_shard / 2 ** 20:.0f} MiB "
+                     f"at tp={tp}, preset {preset!r}) exceeds the "
+                     f"container memory limit ({mem / 2 ** 20:.0f} MiB) — "
+                     "shrink the pool (--kv-pool-pages / --slots / "
+                     "--max-seq-len) or raise the limit")
+    draft = env.get("TPUJOB_DRAFT_MODEL")
+    if draft is not None:
+        dval = (draft.get("value") or "").strip()
+        dgeom = _DRAFT_PRESET_GEOM.get(dval)
+        if dgeom is not None and (dgeom[0] % tp or dgeom[1] % tp):
+            _err(errors, where,
+                 f"draft preset {dval!r} (n_heads={dgeom[0]}, "
+                 f"num_kv_heads={dgeom[1]}) is not divisible by "
+                 f"TPUJOB_SERVE_TP ({tp}) — the draft model shards over "
+                 "the same tp mesh")
 
 
 def _check_flight(errors, where: str, c: dict) -> None:

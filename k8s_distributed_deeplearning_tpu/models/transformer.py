@@ -31,6 +31,7 @@ import jax.numpy as jnp
 from jax.ad_checkpoint import checkpoint_name
 
 from k8s_distributed_deeplearning_tpu.ops import attention as attention_ops
+from k8s_distributed_deeplearning_tpu.ops import collectives
 from k8s_distributed_deeplearning_tpu.ops import pallas_paged_attn
 
 Dtype = Any
@@ -106,6 +107,21 @@ class TransformerConfig:
                                         # "nothing" (minimal memory)
     scan_layers: bool = True            # stack layers via nn.scan
     dropout_rate: float = 0.0
+    tp_axis: str | None = None          # serving tensor parallelism
+                                        # (serve/engine.py): when set, this
+                                        # module is the PER-SHARD model
+                                        # inside a shard_map over that mesh
+                                        # axis — n_heads/n_kv_heads/mlp_dim
+                                        # are the LOCAL (per-shard) counts,
+                                        # and the row-parallel projections
+                                        # (attn o_proj, mlp down_proj) psum
+                                        # their partial outputs over the
+                                        # axis: Megatron's two reductions
+                                        # per block. Training TP does NOT
+                                        # use this — it shards the same
+                                        # logical axes via GSPMD rule
+                                        # tables (parallel/sharding.py) and
+                                        # lets XLA place the collectives.
 
     def __post_init__(self):
         if self.remat_policy not in REMAT_POLICIES:
@@ -545,6 +561,11 @@ class Attention(nn.Module):
                               kernel_init=nn.with_logical_partitioning(
                                   default_init(), ("heads", "head_dim", "embed")),
                               name="o_proj")(out)
+        if cfg.tp_axis is not None:
+            # Row-parallel output projection under serving TP: each shard
+            # holds n_heads/tp heads, so o_proj emits a partial sum over the
+            # hidden dim — one psum completes it (Megatron's g operator).
+            out = collectives.tree_psum(out, cfg.tp_axis)
         return nn.with_logical_constraint(out, ("batch", "seq", "act_embed"))
 
 
@@ -567,8 +588,21 @@ class MLP(nn.Module):
                             use_bias=True)(x)
             h = nn.gelu(h)
         h = nn.with_logical_constraint(h, ("batch", "seq", "mlp"))
+        if cfg.tp_axis is not None and cfg.activation != "swiglu":
+            # The GELU path's down_proj carries a bias; psumming after it
+            # would add the (replicated) bias tp times. Serving TP only
+            # targets the bias-free swiglu family — fail at trace, not with
+            # silently-wrong logits.
+            raise NotImplementedError(
+                "tp_axis requires a bias-free down projection "
+                "(activation='swiglu'); got activation="
+                f"{cfg.activation!r}")
         out = param_dense(cfg.dim, ("mlp", "embed"), "down_proj", cfg.dtype,
                           use_bias=cfg.activation != "swiglu")(h)
+        if cfg.tp_axis is not None:
+            # Row-parallel down projection: partial sum over the sharded mlp
+            # dim — Megatron's second reduction per block.
+            out = collectives.tree_psum(out, cfg.tp_axis)
         return nn.with_logical_constraint(out, ("batch", "seq", "act_embed"))
 
 
@@ -617,6 +651,13 @@ class Block(nn.Module):
         x = x + h
         h = make_norm(cfg, "mlp_norm")(x)
         if self.mlp_factory is not None:
+            if cfg.tp_axis is not None:
+                # Factory MLPs (MoE) don't know about the serving-TP psum
+                # contract — running one under tp_axis would return partial
+                # sums as if complete.
+                raise NotImplementedError(
+                    "tp_axis (serving tensor parallelism) supports only the "
+                    "dense MLP; got a custom mlp_factory")
             h = self.mlp_factory(cfg, name="mlp")(h, decode=decode)
         else:
             h = MLP(cfg, name="mlp")(h)

@@ -66,6 +66,55 @@ DEFAULT_RULES: Rules = (
 )
 
 
+# --------------------------------------------------------------- serving TP
+# Axis + rule table for the serving engine's tensor-parallel decode
+# (serve/engine.py, "graftmesh"): a 1-D ("tp",) mesh, Megatron column/row
+# sharding on the attention and MLP weights, everything else REPLICATED.
+# Unlike the training tables above, vocab/embed stay UNSHARDED on purpose:
+# with the embedding and LM head full on every shard, each shard computes
+# the complete [B, vocab] logits after the last row-parallel psum, so
+# sampling is replicated and the decode path needs no gather at all.
+SERVE_TP_AXIS = "tp"
+SERVE_TP_RULES: Rules = (
+    ("heads", SERVE_TP_AXIS),   # column-parallel q (and o_proj rows)
+    ("kv", SERVE_TP_AXIS),      # column-parallel k/v (GQA head groups)
+    ("mlp", SERVE_TP_AXIS),     # column-parallel gate/up (down_proj rows)
+)
+
+
+def serve_tp_param_specs(abstract_params: PyTree) -> PyTree:
+    """PartitionSpecs for serving TP: the params' logical axis metadata
+    mapped through SERVE_TP_RULES; axes without a rule replicate.
+
+    The result has one ``P`` leaf per *boxed* param, so it works as a
+    pytree prefix of both boxed (LogicallyPartitioned) and plain param
+    trees — usable directly as shard_map in_specs or (wrapped in
+    NamedSharding) as device_put shardings.
+    """
+    logical = nn.get_partition_spec(abstract_params)
+    table = dict(SERVE_TP_RULES)
+
+    def one(spec):
+        if not isinstance(spec, P):
+            return P()
+        return P(*(table.get(ax) for ax in spec))
+
+    return jax.tree.map(one, logical, is_leaf=lambda x: isinstance(x, P))
+
+
+def serve_tp_cache_specs(cache: PyTree) -> PyTree:
+    """PartitionSpecs for the paged KV pool under serving TP: every leaf
+    shards its LAST dim over the tp axis. Pool leaves fold heads as
+    ``[num_pages, page_tokens, kv_heads * head_dim]`` with kv outermost,
+    so a contiguous 1/tp slice of the lane dim IS a whole-head slice —
+    each shard holds its ``kv_heads/tp`` heads' pages; page indices,
+    block tables, and cursors stay common to all shards."""
+    def one(leaf):
+        nd = leaf.ndim if hasattr(leaf, "ndim") else len(leaf.shape)
+        return P(*((None,) * (nd - 1) + (SERVE_TP_AXIS,)))
+    return jax.tree.map(one, cache)
+
+
 def resolve_rules(mesh: Mesh, rules: Rules = DEFAULT_RULES) -> list[tuple[str, Any]]:
     """Drop mesh axes the current mesh doesn't have (or has at size 1), so the
     same rule table works on every topology."""

@@ -41,6 +41,13 @@ def main(argv: list[str] | None = None) -> int:
                          "124M bench config)")
     ap.add_argument("--max-seq-len", type=int, default=512)
     ap.add_argument("--slots", type=int, default=8)
+    ap.add_argument("--tp", type=int, default=0,
+                    help="tensor-parallel width (graftmesh): run each "
+                         "engine's compiled decode/prefill/verify programs "
+                         "under shard_map over the first N devices, with "
+                         "attention/MLP weights and the paged KV pool "
+                         "sharded along the head dimension (0 = "
+                         "single-device, no mesh)")
     ap.add_argument("--replicas", type=int, default=1,
                     help="run this many in-process engine replicas behind "
                          "the failover gateway (serve/gateway.py): health-"
@@ -240,6 +247,8 @@ def main(argv: list[str] | None = None) -> int:
                  "rides the metrics exporter)")
     if args.replicas < 1:
         ap.error(f"--replicas must be >= 1, got {args.replicas}")
+    if args.tp < 0:
+        ap.error(f"--tp must be >= 0 (0 = single-device), got {args.tp}")
     remote = (args.replica_endpoints is not None
               or args.replica_discovery_dir is not None)
     if args.replica_endpoints is not None and args.replica_discovery_dir:
@@ -420,7 +429,7 @@ def main(argv: list[str] | None = None) -> int:
             request_trace_sample=args.request_trace_sample,
             request_log=logger, stats=stats,
             draft_model=draft_model, draft_params=draft_params,
-            spec_k=args.spec_k, flight=flight,
+            spec_k=args.spec_k, flight=flight, tp=args.tp,
             replica_id=(f"r{i}" if args.replicas > 1 or args.autoscale
                         else None))
         for i in range(args.replicas)]
@@ -519,7 +528,7 @@ def main(argv: list[str] | None = None) -> int:
                     request_log=logger, stats=stats,
                     draft_model=draft_model,
                     draft_params=draft_params,
-                    spec_k=args.spec_k, flight=flight)
+                    spec_k=args.spec_k, flight=flight, tp=args.tp)
             autoscale_backend = EngineFactoryBackend(_make_engine)
         discover = None
         if (args.autoscale_k8s_job is not None
@@ -622,6 +631,10 @@ def main(argv: list[str] | None = None) -> int:
             MetricsRegistry)
         registry = MetricsRegistry()
         bridge.serving_collector(registry, stats)
+        if engines:
+            # Remote mode has no local engines; replica-servers export
+            # their own serve_tp from their own /metrics.
+            bridge.tp_collector(registry, engines)
         if gateway is not None:
             bridge.gateway_collector(registry, gateway)
             if controller is not None:

@@ -73,6 +73,7 @@ tests/test_paged_kv.py).
 """
 from __future__ import annotations
 
+import dataclasses
 import functools
 import time
 import zlib
@@ -82,9 +83,13 @@ from typing import Any, Iterable
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import NamedSharding
+from jax.sharding import PartitionSpec as P
 
 from k8s_distributed_deeplearning_tpu import faults as _faults
 from k8s_distributed_deeplearning_tpu.models import generate
+from k8s_distributed_deeplearning_tpu.parallel import mesh as mesh_lib
+from k8s_distributed_deeplearning_tpu.parallel import sharding as sharding_lib
 from k8s_distributed_deeplearning_tpu.serve.page_pool import PagePool
 from k8s_distributed_deeplearning_tpu.serve.prefix_cache import PrefixCache
 from k8s_distributed_deeplearning_tpu.serve.request import (
@@ -136,19 +141,45 @@ def _sample_slots(logits: jax.Array, temps: jax.Array, top_ks: jax.Array,
     return new_keys, toks
 
 
+def _decode_core(model, params: PyTree, cache: PyTree, tokens: jax.Array,
+                 kv_lens: jax.Array, tables: jax.Array, temps: jax.Array,
+                 top_ks: jax.Array, top_ps: jax.Array, keys: jax.Array):
+    logits, cache = generate.slot_decode_step(model, params, cache, tokens,
+                                              kv_lens, block_tables=tables)
+    keys, nxt = _sample_slots(logits, temps, top_ks, top_ps, keys)
+    return nxt, keys, cache
+
+
 @functools.partial(jax.jit, static_argnames=("model",),
-                   donate_argnames=("cache",))
+                   donate_argnames=("cache", "keys"))
 def _decode_program(model, params: PyTree, cache: PyTree, tokens: jax.Array,
                     kv_lens: jax.Array, tables: jax.Array, temps: jax.Array,
                     top_ks: jax.Array, top_ps: jax.Array, keys: jax.Array):
     """THE serving iteration: every slot advances one token through its
     block table. Free slots ride along as inert rows (their tables are all
     scratch, so their writes land in page 0 and are never attended).
-    Compiles once per (model, num_slots, max_blocks)."""
-    logits, cache = generate.slot_decode_step(model, params, cache, tokens,
-                                              kv_lens, block_tables=tables)
-    keys, nxt = _sample_slots(logits, temps, top_ks, top_ps, keys)
-    return nxt, keys, cache
+    Compiles once per (model, num_slots, max_blocks). The pool cache AND
+    the key register are donated: the step updates both in place — no
+    per-iteration arena copy (tests/test_tp_serve.py asserts the aliasing
+    by buffer identity)."""
+    return _decode_core(model, params, cache, tokens, kv_lens, tables,
+                        temps, top_ks, top_ps, keys)
+
+
+def _spec_draft_core(model, params: PyTree, cache: PyTree,
+                     tokens: jax.Array, kv_lens: jax.Array,
+                     tables: jax.Array, steps: int):
+    def body(carry, _):
+        cache, tok, pos = carry
+        logits, cache = generate.slot_decode_step(model, params, cache,
+                                                  tok, pos,
+                                                  block_tables=tables)
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return (cache, nxt, pos + 1), tok
+
+    (cache, _, _), fed = jax.lax.scan(body, (cache, tokens, kv_lens),
+                                      None, length=steps)
+    return fed.T, cache
 
 
 @functools.partial(jax.jit, static_argnames=("model", "steps"),
@@ -165,18 +196,31 @@ def _spec_draft_program(model, params: PyTree, cache: PyTree,
     KV (its logits are discarded), so a fully-accepted window leaves the
     draft cache gap-free at the advanced cursor. Free slots ride along
     inert exactly as in :func:`_decode_program`."""
+    return _spec_draft_core(model, params, cache, tokens, kv_lens, tables,
+                            steps)
 
-    def body(carry, _):
-        cache, tok, pos = carry
-        logits, cache = generate.slot_decode_step(model, params, cache,
-                                                  tok, pos,
-                                                  block_tables=tables)
-        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        return (cache, nxt, pos + 1), tok
 
-    (cache, _, _), fed = jax.lax.scan(body, (cache, tokens, kv_lens),
-                                      None, length=steps)
-    return fed.T, cache
+def _spec_verify_core(model, params: PyTree, cache: PyTree,
+                      window: jax.Array, kv_lens: jax.Array,
+                      tables: jax.Array, temps: jax.Array,
+                      top_ks: jax.Array, top_ps: jax.Array,
+                      keys: jax.Array):
+    logits, cache = generate.slot_verify_step(model, params, cache,
+                                              window, kv_lens,
+                                              block_tables=tables)
+
+    def body(keys, row_logits):
+        new_keys, toks = _sample_slots(row_logits, temps, top_ks, top_ps,
+                                       keys)
+        return new_keys, (toks, new_keys)
+
+    _, (sel, key_states) = jax.lax.scan(body, keys,
+                                        jnp.moveaxis(logits, 1, 0))
+    sel = sel.T                                            # [B, W]
+    key_states = jnp.moveaxis(key_states, 1, 0)            # [B, W, 2]
+    matches = (window[:, 1:] == sel[:, :-1]).astype(jnp.int32)
+    accepted = jnp.sum(jnp.cumprod(matches, axis=1), axis=1)
+    return sel, key_states, accepted, cache
 
 
 @functools.partial(jax.jit, static_argnames=("model",),
@@ -199,28 +243,22 @@ def _spec_verify_program(model, params: PyTree, cache: PyTree,
     accept). Returns ``(sel [B, W], key_states [B, W, 2],
     accepted [B], cache)`` where ``accepted`` is the per-row count of
     leading drafts matching the target's selections."""
-    logits, cache = generate.slot_verify_step(model, params, cache,
-                                              window, kv_lens,
-                                              block_tables=tables)
-
-    def body(keys, row_logits):
-        new_keys, toks = _sample_slots(row_logits, temps, top_ks, top_ps,
-                                       keys)
-        return new_keys, (toks, new_keys)
-
-    _, (sel, key_states) = jax.lax.scan(body, keys,
-                                        jnp.moveaxis(logits, 1, 0))
-    sel = sel.T                                            # [B, W]
-    key_states = jnp.moveaxis(key_states, 1, 0)            # [B, W, 2]
-    matches = (window[:, 1:] == sel[:, :-1]).astype(jnp.int32)
-    accepted = jnp.sum(jnp.cumprod(matches, axis=1), axis=1)
-    return sel, key_states, accepted, cache
+    return _spec_verify_core(model, params, cache, window, kv_lens, tables,
+                             temps, top_ks, top_ps, keys)
 
 
 def _leaf_name(path) -> str | None:
     """Name of a cache leaf from its tree path (DictKey at the tail for
     both unrolled and layer-scanned layouts)."""
     return getattr(path[-1], "key", None)
+
+
+def _chunk_core(model, params: PyTree, cache: PyTree, chunk: jax.Array,
+                table: jax.Array, start: jax.Array):
+    pos = (start + jnp.arange(chunk.shape[1], dtype=jnp.int32))[None, :]
+    _, cache = generate.prefill_chunk(model, params, cache, chunk,
+                                      positions=pos, block_tables=table)
+    return cache
 
 
 @functools.partial(jax.jit, static_argnames=("model",),
@@ -232,10 +270,21 @@ def _chunk_program(model, params: PyTree, cache: PyTree, chunk: jax.Array,
     at absolute positions ``start + [0, C)``. Logits are discarded, so XLA
     dead-code-eliminates the lm_head matmul for every chunk but the final
     one. One compile per C."""
+    return _chunk_core(model, params, cache, chunk, table, start)
+
+
+def _final_chunk_core(model, params: PyTree, cache: PyTree,
+                      chunk: jax.Array, table: jax.Array,
+                      start: jax.Array, length: jax.Array,
+                      temp: jax.Array, top_k: jax.Array,
+                      top_p: jax.Array, key: jax.Array):
     pos = (start + jnp.arange(chunk.shape[1], dtype=jnp.int32))[None, :]
-    _, cache = generate.prefill_chunk(model, params, cache, chunk,
-                                      positions=pos, block_tables=table)
-    return cache
+    logits, cache = generate.prefill_chunk(model, params, cache, chunk,
+                                           positions=pos, block_tables=table)
+    last = jax.lax.dynamic_slice_in_dim(logits, length - 1, 1, axis=1)[:, 0, :]
+    new_key, tok = _sample_slots(last, temp[None], top_k[None], top_p[None],
+                                 key[None])
+    return tok[0], new_key[0], cache
 
 
 @functools.partial(jax.jit, static_argnames=("model",),
@@ -252,13 +301,173 @@ def _final_chunk_program(model, params: PyTree, cache: PyTree,
     prompt length). Pad positions past the table's last block land in the
     pool's scratch page; pad garbage inside the last prompt page sits
     beyond the cursor and is never attended."""
-    pos = (start + jnp.arange(chunk.shape[1], dtype=jnp.int32))[None, :]
-    logits, cache = generate.prefill_chunk(model, params, cache, chunk,
-                                           positions=pos, block_tables=table)
-    last = jax.lax.dynamic_slice_in_dim(logits, length - 1, 1, axis=1)[:, 0, :]
-    new_key, tok = _sample_slots(last, temp[None], top_k[None], top_p[None],
-                                 key[None])
-    return tok[0], new_key[0], cache
+    return _final_chunk_core(model, params, cache, chunk, table, start,
+                             length, temp, top_k, top_p, key)
+
+
+# ------------------------------------------------- serving TP (graftmesh)
+
+
+def _validate_tp_cfg(cfg, tp: int, what: str) -> None:
+    """Offline TP shardability check — raised at the ctor (and mirrored in
+    launch/validate.py against rendered manifests), never at first trace."""
+    heads = getattr(cfg, "n_heads", None)
+    if heads is None:
+        raise ValueError(
+            f"tp={tp} requires a TransformerConfig-style model config "
+            f"(n_heads/n_kv_heads/mlp_dim); {what} has cfg={cfg!r}")
+    kv = cfg.resolved_kv_heads
+    mlp = cfg.resolved_mlp_dim
+    if heads % tp:
+        raise ValueError(
+            f"{what}: n_heads ({heads}) is not divisible by tp ({tp}) — "
+            "every shard must own whole attention heads")
+    if kv % tp:
+        raise ValueError(
+            f"{what}: num_kv_heads ({kv}) is not divisible by tp ({tp}) — "
+            "the paged pool shards along the KV head dim, so every shard "
+            f"must hold kv_heads/tp whole heads (try tp in "
+            f"{[d for d in (1, 2, 4, 8) if d <= kv and kv % d == 0]})")
+    if mlp % tp:
+        raise ValueError(
+            f"{what}: mlp_dim ({mlp}) is not divisible by tp ({tp}) — "
+            "the column-parallel gate/up projections split the hidden dim")
+    if cfg.activation != "swiglu":
+        raise ValueError(
+            f"{what}: serving TP needs a bias-free down projection "
+            f"(activation='swiglu'), got activation={cfg.activation!r} — "
+            "a replicated down_proj bias would be psummed tp times")
+
+
+def _local_tp_model(model, tp: int):
+    """The PER-SHARD model run inside the serving-TP shard_map: identical
+    architecture with n_heads / n_kv_heads / mlp_dim divided by tp and the
+    row-parallel psums switched on (``TransformerConfig.tp_axis``).
+    head_dim is pinned to the full model's resolved value — the default
+    (dim // n_heads) would silently change as n_heads shrinks."""
+    cfg = model.cfg
+    local = dataclasses.replace(
+        cfg,
+        n_heads=cfg.n_heads // tp,
+        n_kv_heads=cfg.resolved_kv_heads // tp,
+        head_dim=cfg.resolved_head_dim,
+        mlp_dim=cfg.resolved_mlp_dim // tp,
+        tp_axis=sharding_lib.SERVE_TP_AXIS)
+    return model.clone(cfg=local)
+
+
+def _tp_param_specs(model) -> PyTree:
+    """PartitionSpec prefix tree for the model's params under serving TP
+    (parallel/sharding.py rule table: heads/kv/mlp -> "tp", everything
+    else — embeddings, LM head, norms — replicated). eval_shape only: no
+    FLOPs, no device memory."""
+    dummy = jnp.zeros((1, 8), jnp.int32)
+    abstract = jax.eval_shape(
+        functools.partial(model.init, jax.random.PRNGKey(0)), dummy)
+    return sharding_lib.serve_tp_param_specs(abstract["params"])
+
+
+class _TpPrograms:
+    """The compiled serving programs for ONE model under the serving-TP
+    shard_map — the same five program bodies as the module-level tp=0
+    programs (shared ``*_core`` functions, so the paths cannot drift),
+    wrapped in ``shard_map`` over a 1-D ("tp",) mesh. The mesh and specs
+    are per-configuration state, so these cannot be plain module-level
+    jits — construct through :func:`_tp_programs_for`, which memoizes on
+    (model, mesh, specs) so a fresh engine reuses the jit cache exactly
+    like the tp=0 programs do.
+
+    Specs: params follow :func:`_tp_param_specs` (Megatron column/row
+    sharding, replicated embeddings/LM head); the paged pool shards every
+    leaf's last (folded kv·head_dim) dim; every host register operand —
+    tokens, cursors, block tables, sampling params, keys — is replicated.
+    Because the LM head is replicated, each shard computes the full
+    [B, vocab] logits after the last row-parallel psum and sampling is
+    replicated too: token outputs need no gather, and the host bookkeeping
+    above this seam is identical to tp=0. ``check_vma=False``: outputs
+    declared replicated are replicated by construction (same program, same
+    replicated inputs on every shard), which the static checker cannot
+    prove through the psum chain.
+
+    The pool cache is donated in every program (and the key register in
+    decode), so the sharded arena is updated in place per step."""
+
+    def __init__(self, local_model, mesh, param_specs, cache_specs, *,
+                 spec_steps: int = 0):
+        rep = P()
+
+        def smap(fn, n_host_operands, out_specs):
+            return jax.shard_map(
+                fn, mesh=mesh,
+                in_specs=(param_specs, cache_specs) + (rep,) * n_host_operands,
+                out_specs=out_specs, check_vma=False)
+
+        def decode(params, cache, tokens, kv_lens, tables, temps, top_ks,
+                   top_ps, keys):
+            return smap(functools.partial(_decode_core, local_model), 7,
+                        (rep, rep, cache_specs))(
+                params, cache, tokens, kv_lens, tables, temps, top_ks,
+                top_ps, keys)
+
+        self.decode = jax.jit(decode, donate_argnums=(1, 8))
+
+        def chunk(params, cache, chunk_toks, table, start):
+            return smap(functools.partial(_chunk_core, local_model), 3,
+                        cache_specs)(params, cache, chunk_toks, table, start)
+
+        self.chunk = jax.jit(chunk, donate_argnums=(1,))
+
+        def final_chunk(params, cache, chunk_toks, table, start, length,
+                        temp, top_k, top_p, key):
+            return smap(functools.partial(_final_chunk_core, local_model),
+                        8, (rep, rep, cache_specs))(
+                params, cache, chunk_toks, table, start, length, temp,
+                top_k, top_p, key)
+
+        self.final_chunk = jax.jit(final_chunk, donate_argnums=(1,))
+
+        def spec_verify(params, cache, window, kv_lens, tables, temps,
+                        top_ks, top_ps, keys):
+            return smap(functools.partial(_spec_verify_core, local_model),
+                        7, (rep, rep, rep, cache_specs))(
+                params, cache, window, kv_lens, tables, temps, top_ks,
+                top_ps, keys)
+
+        self.spec_verify = jax.jit(spec_verify, donate_argnums=(1,))
+
+        self.spec_draft = None
+        if spec_steps:
+            def spec_draft(params, cache, tokens, kv_lens, tables):
+                return smap(
+                    functools.partial(_spec_draft_core, local_model,
+                                      steps=spec_steps),
+                    3, (rep, cache_specs))(
+                    params, cache, tokens, kv_lens, tables)
+
+            self.spec_draft = jax.jit(spec_draft, donate_argnums=(1,))
+
+
+_TP_PROGRAM_CACHE: dict = {}
+
+
+def _tp_programs_for(local_model, mesh, param_specs, cache_specs, *,
+                     spec_steps: int = 0) -> _TpPrograms:
+    """Memoized :class:`_TpPrograms`: engines with the same local model,
+    mesh, and pool layout share one set of jitted wrappers. Without this,
+    every ServeEngine ctor would mint fresh ``jax.jit`` objects and pay
+    full recompiles — the tp=0 path never does (its programs are
+    module-level jits), and the bench's < 2% tp=1 overhead gate holds the
+    tp path to the same standard. param_specs is derived from the model,
+    so it needs no key of its own."""
+    spec_leaves, spec_treedef = jax.tree.flatten(
+        cache_specs, is_leaf=lambda s: isinstance(s, P))
+    key = (local_model, mesh, spec_steps, spec_treedef, tuple(spec_leaves))
+    progs = _TP_PROGRAM_CACHE.get(key)
+    if progs is None:
+        progs = _TP_PROGRAM_CACHE[key] = _TpPrograms(
+            local_model, mesh, param_specs, cache_specs,
+            spec_steps=spec_steps)
+    return progs
 
 
 class _InFlight:
@@ -361,6 +570,22 @@ class ServeEngine:
     window before it is read. The draft model must share the target's
     vocabulary and cover its ``max_seq_len``.
 
+    ``tp`` (default 0 = single-device) turns on tensor-parallel decode
+    ("graftmesh"): the engine builds a 1-D ``("tp",)`` mesh over the
+    first ``tp`` visible devices and runs the SAME compiled programs
+    under ``shard_map`` — attention/MLP weights Megatron column/row
+    sharded with one psum per sublayer, the paged KV pool sharded along
+    the KV head dim (each shard holds ``[num_pages, page_tokens,
+    kv_heads/tp · head_dim]``), embeddings and LM head replicated so
+    sampling is replicated and token outputs need no gather. Block
+    tables, cursors, refcounts, the prefix trie and the scheduler stay
+    host-side and replicated, so admission, prefix hits, chunked
+    prefill, page growth, migration and speculative decoding work
+    unchanged on top of sharded storage. Head/mlp divisibility and mesh
+    size are validated here (and offline in launch/validate.py), never
+    at first trace. ``tp=1`` is the shard_map path on one device —
+    the overhead-measurement variant (bench.py --suite tp).
+
     ``tenants`` (optional) configures the SLO-aware multi-tenant
     scheduler (serve/sched): per-tenant EDF queues drained by
     deficit-weighted round-robin under strict priority classes, with
@@ -384,7 +609,8 @@ class ServeEngine:
                  request_log: "Any | None" = None,
                  replica_id: str | None = None,
                  draft_model=None, draft_params: PyTree | None = None,
-                 spec_k: int = 0, flight: "Any | None" = None):
+                 spec_k: int = 0, flight: "Any | None" = None,
+                 tp: int = 0):
         if num_slots < 2:
             raise ValueError(f"num_slots must be >= 2, got {num_slots}")
         cfg = getattr(model, "cfg", None)
@@ -431,6 +657,20 @@ class ServeEngine:
                     f"draft max_seq_len ({dmax}) < target max_seq_len "
                     f"({max_seq}) — the draft cache shares the target's "
                     "block tables and must cover every position")
+        self.tp = int(tp)
+        if self.tp < 0:
+            raise ValueError(f"tp must be >= 0 (0 = single-device), got {tp}")
+        if self.tp:
+            _validate_tp_cfg(cfg, self.tp, "target model")
+            ndev = len(jax.devices())
+            if ndev < self.tp:
+                raise ValueError(
+                    f"tp={self.tp} needs {self.tp} devices, but only {ndev} "
+                    f"{'is' if ndev == 1 else 'are'} visible — lower tp, or "
+                    "expose more devices (CPU: XLA_FLAGS="
+                    "--xla_force_host_platform_device_count=N)")
+            if draft_model is not None:
+                _validate_tp_cfg(dcfg, self.tp, "draft model")
         self.model = model
         self.params = params
         self.num_slots = num_slots
@@ -503,6 +743,22 @@ class ServeEngine:
         self._keys = np.zeros((num_slots, 2), np.uint32)
         self._slots: list[_InFlight | None] = [None] * num_slots
         self._pending: dict[int, _PendingPrefill] = {}
+        # Serving tensor parallelism (graftmesh): a 1-D ("tp",) mesh over
+        # the first tp devices. The params are placed column/row-sharded
+        # once here, the pool cache below is built sharded-at-birth along
+        # its folded KV-head dim, and _TpPrograms wraps the same program
+        # bodies as tp=0 in shard_map — every host-side structure (block
+        # tables, cursors, refcounts, trie, scheduler) stays replicated
+        # and mode-blind.
+        self._mesh = None
+        self._tp_programs: _TpPrograms | None = None
+        self._tp_draft_programs: _TpPrograms | None = None
+        if self.tp:
+            self._mesh = mesh_lib.make_mesh(
+                {sharding_lib.SERVE_TP_AXIS: self.tp},
+                devices=jax.devices()[:self.tp])
+            self.params = jax.device_put(
+                self.params, self._named_shardings(_tp_param_specs(model)))
         # Single-row cache SHAPES (eval_shape: no FLOPs) — the leaf
         # structure the pool is derived from, and the byte source for
         # _block_nbytes.
@@ -520,10 +776,25 @@ class ServeEngine:
         self.spec_k = int(spec_k)
         self._draft_cache: PyTree | None = None
         if self.spec_k:
+            if self.tp:
+                self.draft_params = jax.device_put(
+                    self.draft_params,
+                    self._named_shardings(_tp_param_specs(draft_model)))
             _, draft_shapes = jax.eval_shape(
                 lambda p, t: generate.prefill(self.draft_model, p, t),
                 self.draft_params, dummy)
             self._draft_cache = self._init_pool_cache(draft_shapes)
+        if self.tp:
+            self._tp_programs = _tp_programs_for(
+                _local_tp_model(model, self.tp), self._mesh,
+                _tp_param_specs(model),
+                sharding_lib.serve_tp_cache_specs(self._cache))
+            if self.spec_k:
+                self._tp_draft_programs = _tp_programs_for(
+                    _local_tp_model(draft_model, self.tp), self._mesh,
+                    _tp_param_specs(draft_model),
+                    sharding_lib.serve_tp_cache_specs(self._draft_cache),
+                    spec_steps=self.spec_k + 1)
         self.prefix_cache: PrefixCache | None = None
         if prefix_cache_mb is not None and prefix_cache_mb > 0:
             self.prefix_cache = PrefixCache(
@@ -536,6 +807,12 @@ class ServeEngine:
         self._step_prefill_budget: int | None = None
         self._record_pool_gauges()
 
+    def _named_shardings(self, specs: PyTree) -> PyTree:
+        """PartitionSpec tree -> NamedSharding tree over the tp mesh
+        (prefix-compatible: works against boxed and plain param trees)."""
+        return jax.tree.map(lambda s: NamedSharding(self._mesh, s), specs,
+                            is_leaf=lambda s: isinstance(s, P))
+
     def _init_pool_cache(self, row_shapes: PyTree) -> PyTree:
         """Zero-filled page pool with the cache-leaf structure a prefill
         produces (``row_shapes``: the target model's single-row
@@ -544,7 +821,10 @@ class ServeEngine:
         nothing else) and reshaping each leaf's [..., 1, max_seq, F] row
         layout to [..., num_pages, page_tokens, F]. KV content is
         irrelevant — nothing is attended until a table maps a written
-        page."""
+        page. Under tp the pool is built SHARDED-AT-BIRTH along each
+        leaf's folded kv·head_dim lane dim (jit + out_shardings): every
+        shard materializes only its kv_heads/tp slice of each page, so
+        the full pool never exists on one device."""
         bt, pages = self.page_tokens, self.pool.num_pages
 
         def build(tree):
@@ -561,7 +841,13 @@ class ServeEngine:
                     out[name] = jnp.zeros(shape, v.dtype)
             return out
 
-        return build(row_shapes)
+        if self._mesh is None:
+            return build(row_shapes)
+        abstract = jax.eval_shape(lambda: build(row_shapes))
+        shardings = self._named_shardings(
+            sharding_lib.serve_tp_cache_specs(abstract))
+        return jax.jit(lambda: build(row_shapes),
+                       out_shardings=shardings)()
 
     def _block_nbytes(self, block_tokens: int) -> int:
         """Bytes of KV one pool page holds (seq dim of every cached_key/
@@ -787,10 +1073,7 @@ class ServeEngine:
             self._step_epilogue()
             return outputs
         with self.tracer.span("decode", active=active):
-            nxt, keys, self._cache = _decode_program(
-                self.model, self.params, self._cache, self._tokens,
-                self._kv_lens, self._tables, self._temps, self._top_ks,
-                self._top_ps, self._keys)
+            nxt, keys, self._cache = self._decode_step()
             # graftlint: disable=host-sync — the iteration's one honest
             # sync: every slot's sampled token in a single device fence.
             nxt = np.asarray(nxt)
@@ -837,15 +1120,10 @@ class ServeEngine:
         truncation: rejected drafts stay in pages beyond the advanced
         cursor, never attended, overwritten in place by the next window
         before anything reads them."""
-        w = self.spec_k + 1
         with self.tracer.span("decode", active=active, spec_k=self.spec_k):
-            window, self._draft_cache = _spec_draft_program(
-                self.draft_model, self.draft_params, self._draft_cache,
-                self._tokens, self._kv_lens, self._tables, steps=w)
-            sel, key_states, acc, self._cache = _spec_verify_program(
-                self.model, self.params, self._cache, window,
-                self._kv_lens, self._tables, self._temps, self._top_ks,
-                self._top_ps, self._keys)
+            window, self._draft_cache = self._spec_draft_step()
+            sel, key_states, acc, self._cache = self._spec_verify_step(
+                window)
             # graftlint: disable=host-sync — the iteration's one honest
             # sync: every slot's window/selections in a single fence.
             window = np.asarray(window)
@@ -962,10 +1240,79 @@ class ServeEngine:
         self._check_page_leaks("shutdown")
         return outs
 
+    # ------------------------------------------------- program dispatch
+    # The ONE seam between tp=0 (module-level jit programs, shared across
+    # engines in the process) and tp>=1 (per-engine shard_map'd programs
+    # over self._mesh). Signatures and semantics are identical on both
+    # sides — everything above this seam (admission, trie, chunked
+    # prefill, growth, migration, spec bookkeeping) is mode-blind.
+
+    # graftlint: hot-path
+    def _decode_step(self):
+        if self.tp:
+            return self._tp_programs.decode(
+                self.params, self._cache, self._tokens, self._kv_lens,
+                self._tables, self._temps, self._top_ks, self._top_ps,
+                self._keys)
+        return _decode_program(
+            self.model, self.params, self._cache, self._tokens,
+            self._kv_lens, self._tables, self._temps, self._top_ks,
+            self._top_ps, self._keys)
+
+    # graftlint: hot-path
+    def _spec_draft_step(self):
+        if self.tp:
+            return self._tp_draft_programs.spec_draft(
+                self.draft_params, self._draft_cache, self._tokens,
+                self._kv_lens, self._tables)
+        return _spec_draft_program(
+            self.draft_model, self.draft_params, self._draft_cache,
+            self._tokens, self._kv_lens, self._tables,
+            steps=self.spec_k + 1)
+
+    # graftlint: hot-path
+    def _spec_verify_step(self, window):
+        if self.tp:
+            return self._tp_programs.spec_verify(
+                self.params, self._cache, window, self._kv_lens,
+                self._tables, self._temps, self._top_ks, self._top_ps,
+                self._keys)
+        return _spec_verify_program(
+            self.model, self.params, self._cache, window, self._kv_lens,
+            self._tables, self._temps, self._top_ks, self._top_ps,
+            self._keys)
+
+    def _chunk_step(self, chunk, table, start, *, draft: bool = False):
+        if draft:
+            if self.tp:
+                return self._tp_draft_programs.chunk(
+                    self.draft_params, self._draft_cache, chunk, table,
+                    start)
+            return _chunk_program(self.draft_model, self.draft_params,
+                                  self._draft_cache, chunk, table, start)
+        if self.tp:
+            return self._tp_programs.chunk(
+                self.params, self._cache, chunk, table, start)
+        return _chunk_program(self.model, self.params, self._cache, chunk,
+                              table, start)
+
+    def _final_chunk_step(self, chunk, table, start, length, temp, top_k,
+                          top_p, key):
+        if self.tp:
+            return self._tp_programs.final_chunk(
+                self.params, self._cache, chunk, table, start, length,
+                temp, top_k, top_p, key)
+        return _final_chunk_program(
+            self.model, self.params, self._cache, chunk, table, start,
+            length, temp, top_k, top_p, key)
+
     def decode_cache_size(self) -> int:
-        """Compiled-program count of the decode step (jit cache entries,
-        shared across engines in the process) — the instrumentation behind
-        the compiles-once acceptance test: run a workload, take the delta."""
+        """Compiled-program count of the decode step (jit cache entries —
+        shared across engines at tp=0, per-engine under tp) — the
+        instrumentation behind the compiles-once acceptance test: run a
+        workload, take the delta."""
+        if self.tp:
+            return self._tp_programs.decode._cache_size()
         return _decode_program._cache_size()
 
     @staticmethod
@@ -1252,18 +1599,15 @@ class ServeEngine:
                         break       # out of budget; resume next iteration
                     chunk = pend.prompt[None, pend.pos:pend.pos + c]
                     with self.tracer.span("prefill", chunk=c, slot=slot):
-                        self._cache = _chunk_program(
-                            self.model, self.params, self._cache,
+                        self._cache = self._chunk_step(
                             np.ascontiguousarray(chunk),
                             np.ascontiguousarray(table),
                             np.int32(pend.pos))
                         if self.spec_k:
-                            self._draft_cache = _chunk_program(
-                                self.draft_model, self.draft_params,
-                                self._draft_cache,
+                            self._draft_cache = self._chunk_step(
                                 np.ascontiguousarray(chunk),
                                 np.ascontiguousarray(table),
-                                np.int32(pend.pos))
+                                np.int32(pend.pos), draft=True)
                     pend.pos += c
                     pend.chunks += 1
                     self._charge_prefill(c)
@@ -1308,9 +1652,8 @@ class ServeEngine:
         table = self._tables[slot:slot + 1]
         with self.tracer.span("prefill", bucket=bucket, slot=slot,
                               cached=pend.hit_tokens):
-            tok, key, self._cache = _final_chunk_program(
-                self.model, self.params, self._cache, chunk,
-                np.ascontiguousarray(table), np.int32(pend.pos),
+            tok, key, self._cache = self._final_chunk_step(
+                chunk, np.ascontiguousarray(table), np.int32(pend.pos),
                 np.int32(rem), np.float32(sp.temperature),
                 np.int32(sp.top_k), np.float32(sp.top_p),
                 np.asarray(jax.random.PRNGKey(req.seed), np.uint32))
@@ -1319,10 +1662,9 @@ class ServeEngine:
                 # DCE'd): same padded chunk, same table, same positions
                 # — pad writes land beyond the cursor or in scratch,
                 # exactly as on the target path.
-                self._draft_cache = _chunk_program(
-                    self.draft_model, self.draft_params,
-                    self._draft_cache, chunk,
-                    np.ascontiguousarray(table), np.int32(pend.pos))
+                self._draft_cache = self._chunk_step(
+                    chunk, np.ascontiguousarray(table), np.int32(pend.pos),
+                    draft=True)
             if self.prefix_cache is not None:
                 # Adopt whole prompt blocks into the trie by REFERENCE:
                 # the trie takes its own refcount on the slot's page, so

@@ -243,6 +243,28 @@ def serving_collector(registry: MetricsRegistry,
     registry.register_collector(collect)
 
 
+def tp_collector(registry: MetricsRegistry, engines) -> None:
+    """Register a collector exporting each local engine's tensor-parallel
+    width (graftmesh): the ``serve_tp`` gauge reports the shard_map mesh
+    size per replica (1 = a single-device engine with no mesh), so the
+    dashboard shows at a glance which replicas run sharded decode and how
+    wide. Engines never change width after construction — the gauge is a
+    config surface, exported pull-time like everything else here."""
+    g = registry.gauge(
+        "serve_tp",
+        "tensor-parallel width per serving replica (shard_map mesh size; "
+        "1 = single-device)",
+        labelnames=("replica",))
+
+    def collect() -> None:
+        for i, eng in enumerate(engines):
+            rid = getattr(eng, "replica_id", None) or f"r{i}"
+            g.labels(replica=str(rid)).set(float(getattr(eng, "tp", 0)
+                                                 or 1))
+
+    registry.register_collector(collect)
+
+
 def sched_collector(registry: MetricsRegistry, sched) -> None:
     """Register a pull-time collector over the multi-tenant scheduler's
     :meth:`serve.sched.TenantScheduler.snapshot`: per-tenant queue depth,

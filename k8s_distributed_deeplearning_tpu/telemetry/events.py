@@ -21,6 +21,8 @@ EVENTS: dict[str, str] = {
     "train_step": "periodic training step record: loss, step time, "
                   "throughput, MFU",
     "eval": "mid-training or final evaluation metrics",
+    # graftlint: disable=event-registry — emitted by examples/train_llama.py,
+    # outside the package tree the lint scans.
     "eval_skipped": "an eval cadence point was skipped (and why)",
     "checkpoint": "a checkpoint write completed",
     "preempted": "SIGTERM consensus reached; checkpointed and exiting",

@@ -99,6 +99,31 @@ def test_cli_exit_zero_on_package_tree():
     assert "0 findings" in proc.stdout
 
 
+def test_axis_constant_extends_collective_universe(tmp_path):
+    """Module-level ``*_AXIS = "name"`` constants declare axes (the
+    serving shard_map idiom: sharding.SERVE_TP_AXIS): a collective naming
+    that literal is clean, while a typo'd neighbour still fires."""
+    ok = tmp_path / "tp_axes.py"
+    ok.write_text(
+        "from jax import lax\n"
+        'SERVE_TP_AXIS = "tpax"\n'
+        "def f(x):\n"
+        '    return lax.psum(x, "tpax")\n')
+    report = analysis.run([str(ok)])
+    assert report.ok, "\n".join(f.format() for f in report.findings)
+
+    bad = tmp_path / "tp_axes_bad.py"
+    bad.write_text(
+        "from jax import lax\n"
+        'SERVE_TP_AXIS = "tpax"\n'
+        'not_a_constant = "lowercase names do not declare axes"\n'
+        "def f(x):\n"
+        '    return lax.psum(x, "tpaxx")\n')
+    report = analysis.run([str(bad)])
+    assert any(f.pass_id == "collective-axis" for f in report.findings), (
+        "typo'd axis next to an _AXIS constant should still fire")
+
+
 # ------------------------------------------------------- the CLI contract
 
 def test_cli_usage_errors_exit_2():

@@ -1,0 +1,386 @@
+"""Tensor-parallel serving (graftmesh): token parity, donation, and the
+launch-layer contract.
+
+The correctness bar is BIT-IDENTITY on emitted token ids: the tp=2
+engine runs the same compiled programs under ``shard_map`` with weights
+and the paged KV pool sharded along the head dim, which reorders float
+reductions (psum) — logits move at float-eps, but the sampled/argmaxed
+TOKEN stream must match the tp=1 engine (and tp=1 must match today's
+no-mesh engine) across every stateful serving path: greedy and
+stochastic sampling, prefix-cache hits, chunked prefill, speculative
+draft/verify, and mid-decode gateway migration. Anything less means the
+sharded pool and the replicated host-side block tables disagreed.
+
+Also here: the donated decode step (pool buffers must be consumed and
+reused in place — no per-step pool copy), the ctor's shardability
+errors, and the offline mirror of those errors in launch/validate.py
+against rendered manifests (including the preset-geometry table pin).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from k8s_distributed_deeplearning_tpu.models import llama
+from k8s_distributed_deeplearning_tpu.serve import (Request, SamplingParams,
+                                                    ServeEngine, ServeGateway)
+from k8s_distributed_deeplearning_tpu.utils.metrics import ServingStats
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = llama.config_tiny(max_seq_len=128, dtype=jnp.float32,
+                            scan_layers=False)
+    model = llama.LlamaLM(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    return model, params, cfg
+
+
+@pytest.fixture(scope="module")
+def draft():
+    """An independent draft with n_kv_heads divisible by 2 (the micro
+    preset's kv=1 is NOT tp=2-shardable — that is a validation test, not
+    a parity fixture). Different weights => partial acceptance => the
+    reject/rollback path runs under tp too."""
+    dcfg = llama.config_tiny(max_seq_len=128, dtype=jnp.float32,
+                             scan_layers=False, dim=32, n_layers=1,
+                             n_heads=2, n_kv_heads=2, mlp_dim=64)
+    dmodel = llama.LlamaLM(dcfg)
+    dparams = dmodel.init(jax.random.PRNGKey(1),
+                          jnp.zeros((1, 8), jnp.int32))["params"]
+    return dmodel, dparams
+
+
+def _mixed_reqs(cfg, seed=0, tag="r"):
+    """4 requests: greedy/sampled alternating, two sharing a 24-token
+    prefix (trie material), lengths that cross the chunked-prefill
+    bucket. Run the same batch twice through one engine and the second
+    pass admits via trie hits."""
+    rng = np.random.default_rng(seed)
+    shared = rng.integers(1, cfg.vocab_size, size=24).astype(np.int32)
+    reqs = []
+    for i, n in enumerate((7, 19, 34, 12)):
+        tail = rng.integers(1, cfg.vocab_size, size=n).astype(np.int32)
+        prompt = np.concatenate([shared, tail]) if i >= 2 else tail
+        sp = (SamplingParams() if i % 2 == 0 else
+              SamplingParams(temperature=0.8, top_k=20, top_p=0.9))
+        reqs.append(Request(prompt=prompt, max_new_tokens=12, sampling=sp,
+                            seed=i + 1, request_id=f"{tag}{i}"))
+    return reqs
+
+
+def _tokens(outs):
+    return {o.request_id: [int(t) for t in o.tokens] for o in outs}
+
+
+# ------------------------------------------------------------- parity
+
+
+def test_tp_parity_mixed_sampling_prefix_and_chunked(tiny):
+    """tp=2 == tp=1 == tp=0 token streams with greedy AND stochastic
+    sampling, prefix-cache hits on admission, and chunked prefill."""
+    model, params, cfg = tiny
+
+    def run(tp):
+        eng = ServeEngine(model, params, num_slots=4, min_bucket=8,
+                          prefill_chunk_tokens=16, prefix_cache_mb=4,
+                          tp=tp)
+        out = _tokens(eng.run(_mixed_reqs(cfg)))
+        # Second pass, same prompts: admission maps the trie's prefix
+        # pages into the slots — the hit path, under the sharded pool.
+        out.update(_tokens(eng.run(_mixed_reqs(cfg, tag="s"))))
+        assert eng.stats.summary()["prefix_cache_hits"] >= 1, \
+            "workload must exercise the trie-hit path"
+        return out
+
+    t0, t1, t2 = run(0), run(1), run(2)
+    assert t1 == t0, "tp=1 under shard_map diverged from the plain engine"
+    assert t2 == t1, "tp=2 diverged from tp=1"
+
+
+def test_tp_parity_speculative(tiny, draft):
+    """Draft/verify at spec_k=4: the sharded draft pool, the multi-token
+    verify pass, and host-side accept/rollback must all agree."""
+    model, params, cfg = tiny
+    dmodel, dparams = draft
+
+    def run(tp):
+        eng = ServeEngine(model, params, num_slots=4, min_bucket=8,
+                          draft_model=dmodel, draft_params=dparams,
+                          spec_k=4, tp=tp)
+        out = _tokens(eng.run(_mixed_reqs(cfg)))
+        assert eng.stats.spec_steps > 0
+        return out
+
+    t0, t1, t2 = run(0), run(1), run(2)
+    assert t1 == t0 and t2 == t1
+    # And spec-vs-plain parity still holds under tp (PR 13's invariant).
+    plain = ServeEngine(model, params, num_slots=4, min_bucket=8, tp=2)
+    assert _tokens(plain.run(_mixed_reqs(cfg))) == t2
+
+
+def test_tp_parity_mid_decode_migration(tiny):
+    """Drain a replica with both replicas mid-decode: the migrated
+    streams (prompt + emitted-cursor resubmission onto the tp peer)
+    stay bit-identical to the tp=1 run of the same scenario."""
+    model, params, cfg = tiny
+
+    def run(tp):
+        stats = ServingStats()
+        engines = [ServeEngine(model, params, num_slots=2, eos_id=None,
+                               min_bucket=8, stats=stats,
+                               replica_id=f"r{i}", tp=tp)
+                   for i in range(2)]
+        gw = ServeGateway(engines, stats=stats)
+        rng = np.random.default_rng(3)
+        for i in range(4):
+            gw.submit(Request(
+                prompt=rng.integers(1, cfg.vocab_size,
+                                    size=9 + 3 * i).astype(np.int32),
+                max_new_tokens=10 + i, request_id=f"m{i}"))
+        outs = []
+        for _ in range(3):                   # both replicas mid-decode
+            outs.extend(gw.step())
+        gw.drain_replica("r0")
+        for _ in range(600):
+            if not gw.busy():
+                break
+            outs.extend(gw.step())
+        assert not gw.busy()
+        assert stats.gateway_migrations >= 1, "drain migrated nothing"
+        return _tokens(outs)
+
+    assert run(2) == run(1)
+
+
+# ----------------------------------------------------------- donation
+
+
+def test_decode_step_donates_pool_and_reuses_buffers(tiny):
+    """Satellite 1's no-copy proof: a decode step must CONSUME the paged
+    pool (every input leaf deleted) and hand back the same device
+    buffers (pointer multiset identity) — the pool is updated in place,
+    never copied per step."""
+    model, params, _ = tiny
+    eng = ServeEngine(model, params, num_slots=2, eos_id=None)
+    eng.submit(Request(prompt=np.arange(1, 9, dtype=np.int32),
+                       max_new_tokens=8))
+    for _ in range(10):
+        if eng.occupied_slots():
+            break
+        eng.step()
+    assert eng.occupied_slots() == 1
+    old = jax.tree.leaves(eng._cache)
+    old_ptrs = sorted(b.unsafe_buffer_pointer() for b in old)
+    eng.step()                               # a pure decode step
+    assert all(b.is_deleted() for b in old), \
+        "decode step left pool buffers alive — donation is off"
+    new_ptrs = sorted(b.unsafe_buffer_pointer()
+                      for b in jax.tree.leaves(eng._cache))
+    assert new_ptrs == old_ptrs, \
+        "decode step allocated a fresh pool instead of reusing donated " \
+        "buffers"
+    # The sampling-key register is donated too once it lives on device
+    # (admission rewrites it host-side, so it re-uploads on the next
+    # step and is consumed from then on).
+    keys = eng._keys
+    if isinstance(keys, jax.Array):
+        eng.step()
+        assert keys.is_deleted()
+
+
+def test_tp_decode_step_donates_sharded_pool(tiny):
+    """Same contract through the shard_map program: tp donation consumes
+    the sharded pool leaves (per-shard pointers are not comparable
+    across NamedSharding arrays, so deletion is the assertion)."""
+    model, params, _ = tiny
+    eng = ServeEngine(model, params, num_slots=2, eos_id=None, tp=2)
+    eng.submit(Request(prompt=np.arange(1, 9, dtype=np.int32),
+                       max_new_tokens=8))
+    for _ in range(10):
+        if eng.occupied_slots():
+            break
+        eng.step()
+    old = jax.tree.leaves(eng._cache)
+    eng.step()
+    assert all(b.is_deleted() for b in old)
+
+
+# --------------------------------------------------- ctor validation
+
+
+def test_tp_ctor_rejects_indivisible_heads(tiny):
+    model, params, _ = tiny
+    # config_tiny: n_heads=4, n_kv_heads=2 — tp=3 divides neither.
+    with pytest.raises(ValueError, match="n_heads.*not divisible by tp"):
+        ServeEngine(model, params, num_slots=2, tp=3)
+
+
+def test_tp_ctor_rejects_indivisible_kv_heads():
+    cfg = llama.config_tiny(max_seq_len=64, dtype=jnp.float32,
+                            n_heads=4, n_kv_heads=1)
+    model = llama.LlamaLM(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    with pytest.raises(ValueError, match="num_kv_heads.*try tp in"):
+        ServeEngine(model, params, num_slots=2, tp=2)
+
+
+def test_tp_ctor_rejects_indivisible_draft(tiny):
+    """The micro draft preset (n_kv_heads=1) is the real-world trip
+    wire: target shardable, draft not — the error must name the draft."""
+    model, params, cfg = tiny
+    dcfg = llama.config_tiny(
+        vocab_size=cfg.vocab_size, dim=32, n_layers=1, n_heads=2,
+        n_kv_heads=1, mlp_dim=64, max_seq_len=cfg.max_seq_len,
+        dtype=cfg.dtype)
+    dmodel = llama.LlamaLM(dcfg)
+    dparams = dmodel.init(jax.random.PRNGKey(1),
+                          jnp.zeros((1, 8), jnp.int32))["params"]
+    with pytest.raises(ValueError, match="draft model.*not divisible"):
+        ServeEngine(model, params, num_slots=2, draft_model=dmodel,
+                    draft_params=dparams, spec_k=2, tp=2)
+
+
+def test_tp_ctor_rejects_too_few_devices():
+    # A config divisible by a tp wider than the host's device count, so
+    # the device-count check (not divisibility) is what fires.
+    ndev = len(jax.devices())
+    wide = 2 * ndev
+    cfg = llama.config_tiny(max_seq_len=64, dtype=jnp.float32, dim=wide * 4,
+                            n_heads=wide, n_kv_heads=wide, mlp_dim=wide * 8)
+    model = llama.LlamaLM(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    with pytest.raises(ValueError,
+                       match="xla_force_host_platform_device_count"):
+        ServeEngine(model, params, num_slots=2, tp=wide)
+
+
+def test_tp_ctor_rejects_negative_and_biased_activation(tiny):
+    model, params, _ = tiny
+    with pytest.raises(ValueError, match="tp must be >= 0"):
+        ServeEngine(model, params, num_slots=2, tp=-1)
+    cfg = llama.config_tiny(max_seq_len=64, dtype=jnp.float32,
+                            activation="gelu")
+    gmodel = llama.LlamaLM(cfg)
+    gparams = gmodel.init(jax.random.PRNGKey(0),
+                          jnp.zeros((1, 8), jnp.int32))["params"]
+    with pytest.raises(ValueError, match="bias-free down projection"):
+        ServeEngine(gmodel, gparams, num_slots=2, tp=2)
+
+
+# --------------------------------------- launch-layer offline contract
+
+
+def test_validate_preset_geometry_table_matches_real_configs():
+    """launch/validate.py checks divisibility offline against a pinned
+    (n_heads, kv, head_dim, layers, kv_itemsize) table; pin it to the
+    REAL configs the serve CLI builds so preset drift breaks here, not
+    on a TPU pod at boot."""
+    from k8s_distributed_deeplearning_tpu.launch import validate
+
+    presets = {
+        "tiny": llama.config_tiny(max_seq_len=512, dtype=jnp.float32),
+        "small": llama.config_tiny(
+            vocab_size=32000, dim=768, n_layers=12, n_heads=12,
+            n_kv_heads=4, mlp_dim=2048, max_seq_len=512,
+            dtype=jnp.bfloat16, scan_layers=False),
+    }
+    for name, cfg in presets.items():
+        heads, kv, head_dim, layers, itemsize = \
+            validate._SERVE_PRESET_GEOM[name]
+        assert (heads, kv, head_dim, layers) == (
+            cfg.n_heads, cfg.resolved_kv_heads, cfg.resolved_head_dim,
+            cfg.n_layers), f"preset {name!r} drifted from the table"
+        assert itemsize == jnp.dtype(cfg.dtype).itemsize
+    # Draft presets: micro is a fixed recipe, tiny mirrors config_tiny.
+    assert validate._DRAFT_PRESET_GEOM["micro"] == (2, 1)
+    tiny_cfg = presets["tiny"]
+    assert validate._DRAFT_PRESET_GEOM["tiny"] == (
+        tiny_cfg.n_heads, tiny_cfg.resolved_kv_heads)
+
+
+def _replica_docs(**kw):
+    from k8s_distributed_deeplearning_tpu.config import JobConfig
+    from k8s_distributed_deeplearning_tpu.launch import render
+    return render.render_all(JobConfig(serve_replicas=2, **kw))
+
+
+def _replica_container(docs):
+    rep = next(d for d in docs if d["kind"] == "Job" and
+               (d["metadata"].get("labels") or {}).get("role")
+               == "serve-replica")
+    return rep["spec"]["template"]["spec"]["containers"][0]
+
+
+def test_render_tp_chips_env_and_flag():
+    """serve_tp renders three ways that must agree: the replica Job's
+    chip request, the TPUJOB_SERVE_TP env (the offline-checkable
+    record), and --tp on the serve command — and the result validates
+    clean."""
+    from k8s_distributed_deeplearning_tpu.launch import validate
+
+    docs = _replica_docs(serve_tp=2)
+    assert validate.validate(docs) == []
+    c = _replica_container(docs)
+    assert int(c["resources"]["limits"]["google.com/tpu"]) == 2
+    env = {e["name"]: e.get("value") for e in c["env"]}
+    assert env["TPUJOB_SERVE_TP"] == "2"
+    assert "--tp 2" in " ".join(c["command"])
+
+
+def test_validate_catches_tp_chip_mismatch_and_indivisible_preset():
+    from k8s_distributed_deeplearning_tpu.launch import validate
+
+    docs = _replica_docs(serve_tp=2)
+    c = _replica_container(docs)
+    c["resources"]["limits"]["google.com/tpu"] = 4
+    errs = validate.validate(docs)
+    assert any("TPUJOB_SERVE_TP (2) != google.com/tpu limit (4)"
+               in e for e in errs)
+
+    # tiny preset: n_heads=4, kv=2 — tp=8 divides neither.
+    docs = _replica_docs(serve_tp=8)
+    errs = validate.validate(docs)
+    assert any("not divisible by TPUJOB_SERVE_TP (8)" in e for e in errs)
+
+    docs = _replica_docs(serve_tp=2)
+    c = _replica_container(docs)
+    for e in c["env"]:
+        if e["name"] == "TPUJOB_SERVE_TP":
+            e["value"] = "zero"
+    errs = validate.validate(docs)
+    assert any("must be an integer >= 1" in e for e in errs)
+
+
+def test_validate_catches_tp_pool_overflow():
+    """A per-shard KV pool bigger than the container memory limit is an
+    OOMKilled replica on a scheduled TPU slice — caught offline."""
+    from k8s_distributed_deeplearning_tpu.launch import validate
+
+    docs = _replica_docs(serve_tp=2)
+    c = _replica_container(docs)
+    c["resources"]["limits"]["memory"] = "1Mi"
+    errs = validate.validate(docs)
+    assert any("per-shard KV pool" in e and "exceeds the container "
+               "memory limit" in e for e in errs)
+
+
+def test_tp_gauge_exported_per_replica(tiny):
+    """The serve_tp gauge (Grafana panel 23) reports each replica's mesh
+    width; single-device engines report 1."""
+    from k8s_distributed_deeplearning_tpu.telemetry import bridge
+    from k8s_distributed_deeplearning_tpu.telemetry.registry import (
+        MetricsRegistry)
+
+    model, params, _ = tiny
+    engines = [ServeEngine(model, params, num_slots=2, replica_id="r0",
+                           tp=2),
+               ServeEngine(model, params, num_slots=2, replica_id="r1")]
+    reg = MetricsRegistry()
+    bridge.tp_collector(reg, engines)
+    text = reg.render()
+    assert 'serve_tp{replica="r0"} 2' in text
+    assert 'serve_tp{replica="r1"} 1' in text
