@@ -158,6 +158,17 @@ class JobConfig:
     # readiness /readyz (flips 503 the moment drain starts, so the
     # routing layer stops sending NEW work ahead of the handshake).
     serve_replicas: int | None = None
+    # Disaggregated serving (serve/disagg.py): when serve_prefill_replicas
+    # is set the renderer emits a THIRD serving tier — an Indexed Job of
+    # prefill-role replica-servers (serve/cli.py --role prefill) behind
+    # their own headless Service — and the gateway pod becomes the disagg
+    # coordinator (--disagg --prefill-endpoints <prefill pod DNS>):
+    # prompts prefill on the prefill tier, finished KV pages ship to the
+    # least-loaded decode replica over /pages, and with no healthy
+    # prefill worker every request falls back to unified decode-local
+    # prefill. Requires serve_replicas (the decode tier); validate.py
+    # enforces that plus per-role pool-byte and port checks offline.
+    serve_prefill_replicas: int | None = None
     serve_preset: str = "tiny"       # model preset for both serving roles
     serve_slots: int | None = None   # per-replica decode slots (None = CLI default)
     serve_tp: int | None = None      # tensor-parallel width per replica

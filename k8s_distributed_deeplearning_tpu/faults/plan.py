@@ -40,6 +40,13 @@ Sites (where the hook points live):
                        ``ioerror`` here are the AMBIGUOUS failure (request
                        landed, response lost), the case idempotent submit
                        exists for; ``stall`` is response latency
+- ``transport_pages``  KV page shipping (``serve/disagg.py`` over
+                       ``serve/transport.py``), client side, before each
+                       ``/pages`` chunk leaves — ``ioerror``/``drop`` lose
+                       a chunk in flight (idempotent transfer keys make
+                       the retry exactly-once), ``stall`` is shipping
+                       latency, ``partition`` severs the prefill→decode
+                       link for *seconds*
 - ``autoscale_actuate`` fleet controller (``serve/autoscale.py``), before
                        each backend start/stop actuation — ``step``
                        carries the CONTROL-ROUND index; ``ioerror`` = the
@@ -77,7 +84,7 @@ import json
 
 SITES = ("step", "data_wait", "shard_read", "checkpoint_saved", "heartbeat",
          "serve_decode", "gateway_dispatch", "executor", "transport_send",
-         "transport_recv", "autoscale_actuate")
+         "transport_recv", "transport_pages", "autoscale_actuate")
 ACTIONS = ("exit", "sigterm", "stall", "ioerror", "truncate", "corrupt",
            "stop", "drop", "partition")
 
@@ -94,6 +101,7 @@ _SITE_ACTIONS = {
     "executor": ("exit", "sigterm"),
     "transport_send": ("ioerror", "stall", "drop", "partition"),
     "transport_recv": ("ioerror", "stall", "drop", "partition"),
+    "transport_pages": ("ioerror", "stall", "drop", "partition"),
     "autoscale_actuate": ("ioerror", "stall", "exit"),
 }
 

@@ -84,6 +84,15 @@ def main(argv: list[str] | None = None) -> int:
                  "gateway Job dispatching to them over HTTP "
                  "(serve/transport.py), probes split /readyz vs /healthz")
         p.add_argument(
+            "--serve-prefill-replicas", type=int,
+            default=d.serve_prefill_replicas,
+            help="with --serve-replicas: also render the disaggregated "
+                 "prefill tier (serve/disagg.py) — a headless Service + "
+                 "Indexed Job of N prefill-role replica-server pods "
+                 "(--role prefill), with the gateway pod running the "
+                 "disagg coordinator (--disagg --prefill-endpoints) "
+                 "that ships finished KV pages to the decode tier")
+        p.add_argument(
             "--serve-preset", default=d.serve_preset,
             choices=["tiny", "small"],
             help="model preset the replica-server pods load")
@@ -144,6 +153,7 @@ def main(argv: list[str] | None = None) -> int:
                     termination_grace_s=args.termination_grace_s,
                     pre_stop_sleep_s=args.pre_stop_sleep_s,
                     serve_replicas=args.serve_replicas,
+                    serve_prefill_replicas=args.serve_prefill_replicas,
                     serve_preset=args.serve_preset,
                     serve_slots=args.serve_slots,
                     serve_tp=args.serve_tp)

@@ -257,7 +257,9 @@ def _serving_pod(cfg: JobConfig, *, role: str, container: dict,
             "containers": [container],
         },
     }
-    if role == "serve-replica":
+    if role in ("serve-replica", "serve-prefill"):
+        # Both engine-carrying tiers run on TPU; only the gateway/
+        # coordinator pod is pure CPU dispatch.
         tmpl["spec"]["nodeSelector"] = {
             "cloud.google.com/gke-tpu-accelerator": cfg.tpu_accelerator,
             "cloud.google.com/gke-tpu-topology": cfg.tpu_topology,
@@ -287,16 +289,20 @@ def _serving_job(cfg: JobConfig, *, name: str, role: str, replicas: int,
     }
 
 
-def render_replica_service(cfg: JobConfig) -> dict:
-    """Headless service giving replica-server pods stable DNS — the
-    gateway's ``--replica-endpoints`` list is rendered against these
-    names, so no discovery sidecar is needed in the static topology."""
-    name = f"{cfg.name}-replica"
+def _tier_name(cfg: JobConfig, serve_role: str) -> str:
+    return f"{cfg.name}-replica" if serve_role == "decode" \
+        else f"{cfg.name}-prefill"
+
+
+def _replica_server_service(cfg: JobConfig, *, serve_role: str) -> dict:
+    name = _tier_name(cfg, serve_role)
+    k8s_role = ("serve-replica" if serve_role == "decode"
+                else "serve-prefill")
     return {
         "apiVersion": "v1",
         "kind": "Service",
         "metadata": {"name": name, "namespace": cfg.namespace,
-                     "labels": {"app": cfg.name, "role": "serve-replica"}},
+                     "labels": {"app": cfg.name, "role": k8s_role}},
         "spec": {
             "clusterIP": "None",
             "selector": {"job-name": name},
@@ -305,17 +311,32 @@ def render_replica_service(cfg: JobConfig) -> dict:
     }
 
 
-def render_replica_job(cfg: JobConfig) -> dict:
-    """Replica-server role: one engine per pod behind the transport
-    endpoints (serve/cli.py --replica-server). The completion index is
-    the replica rank, so the command goes through the shell to splice
-    $JOB_COMPLETION_INDEX in."""
-    name = f"{cfg.name}-replica"
+def render_replica_service(cfg: JobConfig) -> dict:
+    """Headless service giving replica-server pods stable DNS — the
+    gateway's ``--replica-endpoints`` list is rendered against these
+    names, so no discovery sidecar is needed in the static topology."""
+    return _replica_server_service(cfg, serve_role="decode")
+
+
+def render_prefill_service(cfg: JobConfig) -> dict:
+    """Headless service for the prefill tier (serve/disagg.py): the
+    coordinator's ``--prefill-endpoints`` list renders against these
+    stable pod DNS names."""
+    return _replica_server_service(cfg, serve_role="prefill")
+
+
+def _replica_server_job(cfg: JobConfig, *, serve_role: str,
+                        replicas: int) -> dict:
+    name = _tier_name(cfg, serve_role)
+    k8s_role = ("serve-replica" if serve_role == "decode"
+                else "serve-prefill")
     serve = (f"exec python -m k8s_distributed_deeplearning_tpu.launch serve"
              f" --replica-server --preset {cfg.serve_preset}"
              f" --metrics-port {cfg.metrics_port}"
              f" --replica-rank ${{JOB_COMPLETION_INDEX}}"
              f" --advertise-host $(hostname -f)")
+    if serve_role != "decode":
+        serve += f" --role {serve_role}"
     if cfg.serve_slots is not None:
         serve += f" --slots {cfg.serve_slots}"
     if cfg.serve_tp is not None:
@@ -327,7 +348,7 @@ def render_replica_job(cfg: JobConfig) -> dict:
     if cfg.flight_dir is not None:
         serve += f" --flight-dir {cfg.flight_dir}"
     container = {
-        "name": "replica",
+        "name": "replica" if serve_role == "decode" else "prefill",
         "image": cfg.image,
         "command": ["/bin/sh", "-c", serve],
         "env": _serving_env(cfg),
@@ -346,17 +367,49 @@ def render_replica_job(cfg: JobConfig) -> dict:
         container["lifecycle"] = {
             "preStop": {"exec": {"command":
                 ["/bin/sh", "-c", f"sleep {int(cfg.pre_stop_sleep_s)}"]}}}
-    return _serving_job(cfg, name=name, role="serve-replica",
-                        replicas=int(cfg.serve_replicas or 1),
-                        container=container, subdomain=name)
+    return _serving_job(cfg, name=name, role=k8s_role,
+                        replicas=replicas, container=container,
+                        subdomain=name)
+
+
+def render_replica_job(cfg: JobConfig) -> dict:
+    """Replica-server role: one engine per pod behind the transport
+    endpoints (serve/cli.py --replica-server). The completion index is
+    the replica rank, so the command goes through the shell to splice
+    $JOB_COMPLETION_INDEX in."""
+    return _replica_server_job(cfg, serve_role="decode",
+                               replicas=int(cfg.serve_replicas or 1))
+
+
+def render_prefill_job(cfg: JobConfig) -> dict:
+    """Prefill-worker role (serve/disagg.py): identical replica-server
+    pods started with ``--role prefill`` — admission + prefill only,
+    finished KV pages exported over /exports for the coordinator to ship
+    to the decode tier. The role rides the heartbeat beacon, so decode
+    discovery never adopts these pods."""
+    return _replica_server_job(
+        cfg, serve_role="prefill",
+        replicas=int(cfg.serve_prefill_replicas or 1))
+
+
+def _tier_endpoints(cfg: JobConfig, serve_role: str,
+                    replicas: int) -> list[str]:
+    name = _tier_name(cfg, serve_role)
+    return [f"{name}-{i}.{name}.{cfg.namespace}:{cfg.metrics_port}"
+            for i in range(replicas)]
 
 
 def gateway_replica_endpoints(cfg: JobConfig) -> list[str]:
     """The host:port each replica-server answers on, via Indexed-Job pod
     DNS through the replica headless Service."""
-    name = f"{cfg.name}-replica"
-    return [f"{name}-{i}.{name}.{cfg.namespace}:{cfg.metrics_port}"
-            for i in range(int(cfg.serve_replicas or 1))]
+    return _tier_endpoints(cfg, "decode", int(cfg.serve_replicas or 1))
+
+
+def gateway_prefill_endpoints(cfg: JobConfig) -> list[str]:
+    """The host:port each prefill worker answers on — the coordinator's
+    ``--prefill-endpoints`` value."""
+    return _tier_endpoints(cfg, "prefill",
+                           int(cfg.serve_prefill_replicas or 1))
 
 
 def render_gateway_job(cfg: JobConfig) -> dict:
@@ -367,6 +420,13 @@ def render_gateway_job(cfg: JobConfig) -> dict:
                "serve",
                "--replica-endpoints", ",".join(gateway_replica_endpoints(cfg)),
                "--metrics-port", str(cfg.metrics_port)]
+    if cfg.serve_prefill_replicas:
+        # Disaggregated topology: the gateway pod runs the disagg
+        # coordinator over the static prefill tier instead of the plain
+        # failover gateway (serve/cli.py --disagg). Mutually exclusive
+        # with the elastic gateway — validate.py flags the combination.
+        command += ["--disagg", "--prefill-endpoints",
+                    ",".join(gateway_prefill_endpoints(cfg))]
     if cfg.autoscale_max is not None:
         # Elastic gateway: the fleet controller runs in this pod and
         # patches the replica Job's parallelism through kubectl
@@ -407,10 +467,15 @@ def render_gateway_job(cfg: JobConfig) -> dict:
 
 def render_serving(cfg: JobConfig) -> list[dict]:
     """The remote-serving tier: replica headless Service + replica-server
-    Indexed Job + gateway Job. Appended to :func:`render_all` output when
-    ``cfg.serve_replicas`` is set."""
-    return [render_replica_service(cfg), render_replica_job(cfg),
-            render_gateway_job(cfg)]
+    Indexed Job + gateway Job, plus — when ``cfg.serve_prefill_replicas``
+    is set — the prefill Service/Job pair of the disaggregated topology.
+    Appended to :func:`render_all` output when ``cfg.serve_replicas`` is
+    set."""
+    docs = [render_replica_service(cfg), render_replica_job(cfg)]
+    if cfg.serve_prefill_replicas:
+        docs += [render_prefill_service(cfg), render_prefill_job(cfg)]
+    docs.append(render_gateway_job(cfg))
+    return docs
 
 
 def render_all(cfg: JobConfig) -> list[dict]:

@@ -452,7 +452,10 @@ def _check_termination(errors, where: str, tmpl: dict,
                  "terminationGracePeriodSeconds or shrink the sleep")
 
 
-_SERVING_ROLES = frozenset({"serve-gateway", "serve-replica"})
+_SERVING_ROLES = frozenset({"serve-gateway", "serve-replica",
+                            "serve-prefill"})
+# Roles that carry a ServeEngine (and therefore a KV pool) in the pod.
+_ENGINE_ROLES = frozenset({"serve-replica", "serve-prefill"})
 
 
 def _probe_port(probe: dict) -> object:
@@ -487,19 +490,59 @@ def _check_serving_probes(errors, where: str, c: dict) -> None:
                  f"TPUJOB_METRICS_PORT ({port})")
 
 
-def _gateway_endpoints(c: dict) -> list[str] | None:
-    """Pull --replica-endpoints out of the gateway command (list argv or
-    a ``sh -c`` string)."""
-    cmd = [str(a) for a in (c.get("command") or []) + (c.get("args") or [])]
+def _container_argv(c: dict) -> list[str]:
     argv: list[str] = []
-    for part in cmd:
-        argv.extend(part.split())
+    for part in (c.get("command") or []) + (c.get("args") or []):
+        argv.extend(str(part).split())
+    return argv
+
+
+def _gateway_endpoints(c: dict,
+                       flag: str = "--replica-endpoints"
+                       ) -> list[str] | None:
+    """Pull an endpoint-list flag out of the gateway command (list argv
+    or a ``sh -c`` string)."""
+    argv = _container_argv(c)
     for i, a in enumerate(argv):
-        if a == "--replica-endpoints" and i + 1 < len(argv):
+        if a == flag and i + 1 < len(argv):
             return [e for e in argv[i + 1].split(",") if e]
-        if a.startswith("--replica-endpoints="):
+        if a.startswith(flag + "="):
             return [e for e in a.partition("=")[2].split(",") if e]
     return None
+
+
+def _check_pool_bytes(errors, where: str, c: dict) -> None:
+    """Per-role KV pool-byte check for every engine-carrying serving role
+    (decode replicas AND prefill workers): the pool geometry the command
+    flags imply must fit the container memory limit, or the pod OOMs at
+    boot after a TPU slice was scheduled for it. With $TPUJOB_SERVE_TP
+    set, :func:`_check_tp` already covers the per-shard variant — this is
+    the tp=1 path."""
+    env = {e.get("name"): e for e in c.get("env", [])}
+    if env.get("TPUJOB_SERVE_TP") is not None:
+        return
+    cmd = " ".join(str(x) for x in
+                   (c.get("command") or []) + (c.get("args") or []))
+    m = re.search(r"--preset\s+(\S+)", cmd)
+    geom = _SERVE_PRESET_GEOM.get(m.group(1) if m else "tiny")
+    if geom is None:
+        return
+    heads, kv, head_dim, layers, itemsize = geom
+    slots = _int_flag(cmd, "--slots", 8)
+    max_seq = _int_flag(cmd, "--max-seq-len", 512)
+    pool = _int_flag(cmd, "--kv-pool-pages", 0)
+    page_tokens = 32                # engine default: min_bucket
+    blocks = -(-max_seq // page_tokens)
+    pages = (pool if pool > 0 else slots * blocks) + 1
+    total = pages * page_tokens * kv * head_dim * itemsize * 2 * layers
+    mem = _qty_bytes((c.get("resources", {}).get("limits") or {})
+                     .get("memory", ""))
+    if mem is not None and total > mem:
+        _err(errors, where,
+             f"KV pool (~{total / 2 ** 20:.0f} MiB) exceeds the "
+             f"container memory limit ({mem / 2 ** 20:.0f} MiB) — "
+             "shrink the pool (--kv-pool-pages / --slots / "
+             "--max-seq-len) or raise the limit")
 
 
 def _check_serving_job(errors, where: str, job: dict,
@@ -518,39 +561,73 @@ def _check_serving_job(errors, where: str, job: dict,
     subdomain = tmpl.get("subdomain")
     svc = next((s for s in by_kind.get("Service", [])
                 if s["metadata"].get("name") == subdomain), None)
-    if role == "serve-replica":
+    if role in _ENGINE_ROLES:
+        tier = "replica" if role == "serve-replica" else "prefill"
+        for c in containers:
+            _check_pool_bytes(errors, where, c)
         metrics_ports = [p.get("containerPort")
                          for c in containers for p in c.get("ports", [])]
         if svc is None:
             _err(errors, where, f"no headless Service named {subdomain!r} "
-                 "rendered — replica pod DNS (the gateway's endpoint "
+                 f"rendered — {tier} pod DNS (the gateway's endpoint "
                  "list) will not resolve")
         else:
             if svc["spec"].get("clusterIP") != "None":
-                _err(errors, where, "replica Service must be headless "
+                _err(errors, where, f"{tier} Service must be headless "
                      "(clusterIP: None) for per-pod DNS")
             for p in [p.get("port") for p in svc["spec"].get("ports", [])]:
                 if p not in metrics_ports:
-                    _err(errors, where, f"replica Service port {p} not "
+                    _err(errors, where, f"{tier} Service port {p} not "
                          f"exposed by the container ({metrics_ports})")
         return
-    # Gateway: its endpoint list must agree with the replica Job.
+    # Gateway: its endpoint lists must agree with the Jobs alongside.
+    argv = _container_argv(containers[0]) if containers else []
     eps = _gateway_endpoints(containers[0]) if containers else None
-    if eps is None:
-        # Discovery-dir gateways carry no static list; nothing to check.
-        return
-    replica_jobs = [j for j in by_kind.get("Job", [])
+    if eps is not None:
+        _check_tier_endpoints(errors, where, eps, by_kind,
+                              role="serve-replica", tier="replica")
+    pre_eps = (_gateway_endpoints(containers[0], "--prefill-endpoints")
+               if containers else None)
+    prefill_jobs = [j for j in by_kind.get("Job", [])
                     if (j["metadata"].get("labels") or {}).get("role")
-                    == "serve-replica"]
-    if not replica_jobs:
-        _err(errors, where, "gateway has --replica-endpoints but no "
-             "serve-replica Job is rendered alongside")
+                    == "serve-prefill"]
+    if pre_eps is None and prefill_jobs:
+        _err(errors, where, "a serve-prefill Job is rendered but the "
+             "gateway does not route to it (--disagg "
+             "--prefill-endpoints) — the prefill tier would be "
+             "scheduled, billed, and never dispatched to")
+    if pre_eps is not None:
+        if "--disagg" not in argv:
+            _err(errors, where, "gateway has --prefill-endpoints "
+                 "without --disagg — the plain failover gateway "
+                 "ignores the prefill tier")
+        if "--autoscale" in argv:
+            _err(errors, where, "gateway combines --disagg with "
+                 "--autoscale — the disagg coordinator replaces the "
+                 "gateway the fleet controller actuates through "
+                 "(serve/cli.py rejects the pair at startup)")
+        _check_tier_endpoints(errors, where, pre_eps, by_kind,
+                              role="serve-prefill", tier="prefill")
+
+
+def _check_tier_endpoints(errors, where: str, eps: list[str],
+                          by_kind: dict[str, list[dict]], *, role: str,
+                          tier: str) -> None:
+    """One endpoint per pod of the tier's Indexed Job, through its
+    headless Service's stable pod DNS, on a port the container exposes —
+    a count or port drift here means a pod that is scheduled, billed,
+    and never dispatched to."""
+    jobs = [j for j in by_kind.get("Job", [])
+            if (j["metadata"].get("labels") or {}).get("role") == role]
+    if not jobs:
+        _err(errors, where, f"gateway has a static {tier} endpoint list "
+             f"but no {role} Job is rendered alongside")
         return
-    rj = replica_jobs[0]
+    rj = jobs[0]
     completions = rj.get("spec", {}).get("completions")
     if len(eps) != completions:
-        _err(errors, where, f"gateway lists {len(eps)} replica endpoints "
-             f"but the replica Job has completions={completions}")
+        _err(errors, where, f"gateway lists {len(eps)} {tier} endpoints "
+             f"but the {tier} Job has completions={completions}")
     r_tmpl = rj.get("spec", {}).get("template", {}).get("spec", {})
     r_sub = r_tmpl.get("subdomain")
     r_name = rj["metadata"].get("name")
@@ -561,16 +638,16 @@ def _check_serving_job(errors, where: str, job: dict,
     for i, ep in enumerate(eps):
         host, sep, port = ep.rpartition(":")
         if not sep or not port.isdigit():
-            _err(errors, where, f"replica endpoint {ep!r} is not "
+            _err(errors, where, f"{tier} endpoint {ep!r} is not "
                  "host:port with a numeric port")
             continue
         expect = f"{r_name}-{i}.{r_sub}.{r_ns}"
         if host != expect:
-            _err(errors, where, f"replica endpoint host {host!r} != "
-                 f"<replica-job>-{i}.<subdomain>.<ns> ({expect!r})")
+            _err(errors, where, f"{tier} endpoint host {host!r} != "
+                 f"<{tier}-job>-{i}.<subdomain>.<ns> ({expect!r})")
         if port not in r_ports:
-            _err(errors, where, f"replica endpoint port {port} not "
-                 f"exposed by the replica container ({sorted(r_ports)})")
+            _err(errors, where, f"{tier} endpoint port {port} not "
+                 f"exposed by the {tier} container ({sorted(r_ports)})")
 
 
 def validate(docs: list[dict]) -> list[str]:

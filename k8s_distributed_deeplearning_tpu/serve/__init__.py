@@ -14,6 +14,8 @@ from k8s_distributed_deeplearning_tpu.serve.autoscale import (
     BROWNOUT_STAGE_NAMES, BrownoutStage, EngineFactoryBackend,
     FleetController, K8sParallelismBackend, LocalProcessBackend,
     default_brownout_stages)
+from k8s_distributed_deeplearning_tpu.serve.disagg import (
+    DisaggCoordinator, PrefillWorker, RemotePrefillWorker)
 from k8s_distributed_deeplearning_tpu.serve.engine import ServeEngine
 from k8s_distributed_deeplearning_tpu.serve.gateway import ServeGateway
 from k8s_distributed_deeplearning_tpu.serve.page_pool import PagePool
@@ -31,6 +33,7 @@ __all__ = ["ServeEngine", "ServeGateway", "Request", "RequestOutput",
            "PagePool", "PrefixCache", "TenantConfig", "TenantScheduler",
            "DEFAULT_TENANT", "load_tenants", "ReplicaServer",
            "ReplicaClient", "discover_replica_clients",
+           "DisaggCoordinator", "PrefillWorker", "RemotePrefillWorker",
            "FleetController", "BrownoutStage", "BROWNOUT_STAGE_NAMES",
            "default_brownout_stages", "EngineFactoryBackend",
            "LocalProcessBackend", "K8sParallelismBackend"]

@@ -187,10 +187,14 @@ class LocalProcessBackend:
                  client_kwargs: dict | None = None,
                  python: str = sys.executable,
                  spawn_timeout_s: float = 60.0,
-                 sleep: Callable[[float], None] = time.sleep):
+                 sleep: Callable[[float], None] = time.sleep,
+                 role: str = "decode"):
         self.heartbeat_dir = heartbeat_dir
         self.preset = preset
         self.slots = slots
+        # Spawned servers advertise this role in their beacons; a
+        # prefill backend starts prefill-only engines (--role prefill).
+        self.role = str(role)
         self.extra_args = tuple(extra_args)
         self.client_kwargs = dict(client_kwargs or {})
         self.python = python
@@ -214,7 +218,8 @@ class LocalProcessBackend:
                "--slots", str(self.slots), "--metrics-port", "0",
                "--port-file", port_file,
                "--heartbeat-dir", self.heartbeat_dir,
-               "--replica-rank", str(rank), *self.extra_args]
+               "--replica-rank", str(rank),
+               "--role", self.role, *self.extra_args]
         proc = subprocess.Popen(cmd, stdout=subprocess.DEVNULL,
                                 stderr=subprocess.DEVNULL)
         deadline = time.monotonic() + self.spawn_timeout_s
@@ -327,11 +332,20 @@ class K8sParallelismBackend:
 
 def heartbeat_discoverer(heartbeat_dir: str, *,
                          stale_after_s: float | None = 10.0,
-                         client_kwargs: dict | None = None
+                         client_kwargs: dict | None = None,
+                         role: str | None = "decode"
                          ) -> Callable[[Iterable[str]], list]:
     """``discover`` hook for async backends: returns the ReplicaClients
     for endpoints advertised in *heartbeat_dir* that the gateway does
-    not already know (by endpoint), fresh beacons only."""
+    not already know (by endpoint), fresh beacons only.
+
+    *role* filters beacons by their advertised role (default "decode",
+    beacons without the extra count as decode) — a disaggregated
+    deployment shares one heartbeat directory across roles, and a
+    decode controller adopting a prefill worker as a decode replica
+    would route decodes at an engine that only ever prefills. One
+    controller per role, each with its own role-filtered discoverer,
+    gives each role its own desired count and scaling signals."""
     client_kwargs = dict(client_kwargs or {})
     seen: set[str] = set()
 
@@ -341,7 +355,8 @@ def heartbeat_discoverer(heartbeat_dir: str, *,
         from k8s_distributed_deeplearning_tpu.telemetry.fleet import (
             discover_endpoints)
         fresh = discover_endpoints(heartbeat_dir,
-                                   stale_after_s=stale_after_s)
+                                   stale_after_s=stale_after_s,
+                                   role=role)
         new = []
         for ep in fresh:
             if ep in seen:
@@ -406,7 +421,8 @@ class FleetController:
                  brownout_stages: Iterable[BrownoutStage] | None = None,
                  discover: Callable[[Iterable[str]], list] | None = None,
                  logger=None,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 role: str = "decode"):
         if min_replicas < 1:
             raise ValueError(f"min_replicas must be >= 1, got "
                              f"{min_replicas}")
@@ -442,6 +458,14 @@ class FleetController:
         self.discover = discover
         self.logger = logger
         self._clock = clock
+        # Which serving role this controller owns. Disaggregated fleets
+        # run one controller per role ("decode", "prefill"), each with
+        # its own desired count, cooldowns and scaling signals — prefill
+        # scales on prompt admission pressure, decode on token-stream
+        # SLO burn — over a role-filtered discoverer/backend. The label
+        # rides on every event and the snapshot so dashboards and
+        # postmortems can tell the two control loops apart.
+        self.role = str(role)
         active = [r for r in gateway.snapshot()["replicas"].values()
                   if not r["draining"]]
         self.desired = min(max(len(active), min_replicas), max_replicas)
@@ -495,6 +519,7 @@ class FleetController:
         reps = self.gateway.snapshot()["replicas"]
         actual = sum(1 for r in reps.values() if not r["draining"])
         return {
+            "role": self.role,
             "desired_replicas": self.desired,
             "actual_replicas": actual,
             "draining_replicas": sum(1 for r in reps.values()
@@ -571,7 +596,7 @@ class FleetController:
             self._begin_removal(victim, replace=True)
             if self.logger is not None:
                 self.logger.emit(
-                    "autoscale_replace", round=self._round,
+                    "autoscale_replace", round=self._round, role=self.role,
                     replica=victim,
                     health=sense["replicas"][victim]["health"],
                     breaker=sense["replicas"][victim]["state"])
@@ -593,7 +618,7 @@ class FleetController:
             self._record_flip(now)
             if self.logger is not None:
                 self.logger.emit(
-                    "autoscale_up", round=self._round,
+                    "autoscale_up", round=self._round, role=self.role,
                     desired=self.desired, actual=actual,
                     fast_burn=sense["fast_burn"],
                     load_per_slot=sense["load_per_slot"],
@@ -612,7 +637,7 @@ class FleetController:
             self._last_up_t = now
             if self.logger is not None:
                 self.logger.emit(
-                    "autoscale_brownout", round=self._round,
+                    "autoscale_brownout", round=self._round, role=self.role,
                     level=self._brownout_level, stage=stage.name,
                     fast_burn=sense["fast_burn"])
             d.update(decision="brownout", level=self._brownout_level,
@@ -630,7 +655,7 @@ class FleetController:
             if self._brownout_level == 0:
                 if self.logger is not None:
                     self.logger.emit("autoscale_restored",
-                                     round=self._round,
+                                     round=self._round, role=self.role,
                                      fast_burn=sense["fast_burn"])
                 d.update(decision="restore", stage=stage.name)
             else:
@@ -656,7 +681,7 @@ class FleetController:
                 self._begin_removal(victim, replace=False)
                 if self.logger is not None:
                     self.logger.emit(
-                        "autoscale_down", round=self._round,
+                        "autoscale_down", round=self._round, role=self.role,
                         desired=self.desired, actual=actual,
                         victim=victim,
                         load_per_slot=sense["load_per_slot"])

@@ -83,6 +83,39 @@ def main(argv: list[str] | None = None) -> int:
                     help="replica-server only: host written into the "
                          "advertised metrics_addr (the address peers "
                          "dial, not the bind address)")
+    ap.add_argument("--role", choices=["decode", "prefill"],
+                    default="decode",
+                    help="replica-server only: disagg serving role "
+                         "(serve/disagg.py). decode = the normal engine; "
+                         "prefill = admission + prefill only — finished "
+                         "prompt KV pages are exported over /exports for "
+                         "a coordinator to ship to a decode replica. The "
+                         "role rides the heartbeat beacon, so gateways "
+                         "and autoscalers never adopt a prefill worker "
+                         "as a decode replica")
+    ap.add_argument("--disagg", action="store_true",
+                    help="remote coordinator mode (needs "
+                         "--replica-discovery-dir): route prompts through "
+                         "prefill-role replica-servers discovered in the "
+                         "heartbeat dir and ship their finished KV pages "
+                         "to the least-loaded decode replica over /pages "
+                         "(serve/disagg.py); with no healthy prefill "
+                         "worker the coordinator falls back to unified "
+                         "decode-local prefill, so disagg is a "
+                         "performance mode, never an availability "
+                         "dependency")
+    ap.add_argument("--prefill-endpoints", default=None, metavar="LIST",
+                    help="with --disagg: static comma-separated "
+                         "host:port list of prefill-role replica-servers "
+                         "(the rendered k8s topology passes stable pod "
+                         "DNS here); with --replica-discovery-dir "
+                         "instead, prefill workers are discovered by "
+                         "their role heartbeat and this flag is not "
+                         "needed")
+    ap.add_argument("--disagg-prefill", type=int, default=0, metavar="N",
+                    help="in-process disagg: run N prefill-only engines "
+                         "in front of the --replicas decode engines and "
+                         "route through the DisaggCoordinator (0 = off)")
     ap.add_argument("--replica-endpoints", default=None, metavar="LIST",
                     help="run the gateway over REMOTE replica-server "
                          "processes at these comma-separated host:port "
@@ -271,6 +304,40 @@ def main(argv: list[str] | None = None) -> int:
     if args.heartbeat_dir is not None and not args.replica_server:
         ap.error("--heartbeat-dir only makes sense with --replica-server "
                  "(gateways discover via --replica-discovery-dir)")
+    if args.role != "decode" and not args.replica_server:
+        ap.error("--role only makes sense with --replica-server (the "
+                 "coordinator side learns roles from heartbeat beacons)")
+    if args.role == "prefill" and args.spec_k:
+        ap.error("--role prefill runs admission + prefill only; "
+                 "speculative decoding is a decode-side knob")
+    if args.disagg_prefill < 0:
+        ap.error(f"--disagg-prefill must be >= 0, got "
+                 f"{args.disagg_prefill}")
+    if args.disagg and not remote:
+        ap.error("--disagg needs a remote decode fleet "
+                 "(--replica-endpoints or --replica-discovery-dir); "
+                 "use --disagg-prefill N for in-process disagg")
+    if args.prefill_endpoints is not None and not args.disagg:
+        ap.error("--prefill-endpoints only makes sense with --disagg")
+    if args.prefill_endpoints is not None \
+            and args.replica_discovery_dir is not None:
+        ap.error("--prefill-endpoints is the static alternative to "
+                 "role-heartbeat discovery; with "
+                 "--replica-discovery-dir the prefill fleet is "
+                 "discovered from the same directory")
+    if args.disagg_prefill and (remote or args.replica_server):
+        ap.error("--disagg-prefill runs in-process prefill engines; "
+                 "use --disagg for a remote fleet, or start prefill "
+                 "replica-servers with --role prefill")
+    if (args.disagg or args.disagg_prefill) and args.autoscale:
+        ap.error("--disagg and --autoscale are not yet composable in "
+                 "one process: the controller actuates through the "
+                 "gateway, which the disagg coordinator replaces (run "
+                 "per-role controllers instead)")
+    if (args.disagg or args.disagg_prefill) \
+            and args.hedge_after_s is not None:
+        ap.error("--hedge-after-s is a gateway knob; the disagg "
+                 "coordinator does not hedge")
     if remote and args.draft_model is not None:
         ap.error("speculative decoding is an engine-side knob: pass "
                  "--draft-model to the replica-server processes, not "
@@ -430,12 +497,27 @@ def main(argv: list[str] | None = None) -> int:
             request_log=logger, stats=stats,
             draft_model=draft_model, draft_params=draft_params,
             spec_k=args.spec_k, flight=flight, tp=args.tp,
+            prefill_only=(args.role == "prefill"),
             replica_id=(f"r{i}" if args.replicas > 1 or args.autoscale
                         else None))
         for i in range(args.replicas)]
     engine = engines[0] if engines else None
+    prefill_engines = []
+    if args.disagg_prefill:
+        prefill_engines = [
+            ServeEngine(
+                model, params, num_slots=args.slots,
+                max_queue=args.max_queue or args.requests,
+                eos_id=args.eos_id, tracer=tracer, tenants=tenant_cfgs,
+                prefill_chunk_tokens=args.prefill_chunk_tokens or None,
+                prefix_cache_mb=args.prefix_cache_mb or None,
+                kv_pool_pages=args.kv_pool_pages or None,
+                request_log=logger, stats=stats, flight=flight,
+                tp=args.tp, prefill_only=True, replica_id=f"p{i}")
+            for i in range(args.disagg_prefill)]
     clients = None
     gateway = None
+    coordinator = None
     if remote:
         from k8s_distributed_deeplearning_tpu.serve.transport import (
             ReplicaClient, discover_replica_clients)
@@ -457,16 +539,50 @@ def main(argv: list[str] | None = None) -> int:
                 ap.error("--replica-endpoints: empty endpoint list")
         if args.hedge_after_s is not None and len(clients) < 2:
             ap.error("--hedge-after-s needs >= 2 remote replicas")
-        gateway = ServeGateway(clients, stats=stats, logger=logger,
-                               hedge_after_s=args.hedge_after_s,
-                               flight=flight)
+        if args.disagg:
+            # Coordinator mode replaces the gateway: decode clients take
+            # dispatches, prefill-role clients (possibly none — then
+            # every request takes the unified fallback) feed them pages.
+            from k8s_distributed_deeplearning_tpu.serve.disagg import (
+                DisaggCoordinator, RemotePrefillWorker)
+            if args.prefill_endpoints is not None:
+                prefill_clients = [
+                    ReplicaClient(ep.strip(), stats=stats, logger=logger,
+                                  flight=flight)
+                    for ep in args.prefill_endpoints.split(",")
+                    if ep.strip()]
+            elif args.replica_discovery_dir is not None:
+                prefill_clients = discover_replica_clients(
+                    args.replica_discovery_dir, stats=stats,
+                    logger=logger, flight=flight, role="prefill")
+            else:
+                prefill_clients = []
+            coordinator = DisaggCoordinator(
+                clients,
+                [RemotePrefillWorker(c) for c in prefill_clients],
+                stats=stats, logger=logger)
+        else:
+            gateway = ServeGateway(clients, stats=stats, logger=logger,
+                                   hedge_after_s=args.hedge_after_s,
+                                   flight=flight)
+    elif args.disagg_prefill:
+        from k8s_distributed_deeplearning_tpu.serve.disagg import (
+            DisaggCoordinator, PrefillWorker)
+        coordinator = DisaggCoordinator(
+            engines, [PrefillWorker(e) for e in prefill_engines],
+            stats=stats, logger=logger)
     elif args.replicas > 1 or args.autoscale:
         # --autoscale forces the gateway even at one replica: the
         # controller actuates through its dynamic membership.
         gateway = ServeGateway(engines, stats=stats, logger=logger,
                                hedge_after_s=args.hedge_after_s,
                                flight=flight)
-    front = gateway if gateway is not None else engine
+    if coordinator is not None:
+        front = coordinator
+    elif gateway is not None:
+        front = gateway
+    else:
+        front = engine
     # What the probes report on: remote mode watches the clients' cached
     # replica states, local mode the engines themselves.
     status_objs = clients if clients is not None else engines
@@ -574,7 +690,13 @@ def main(argv: list[str] | None = None) -> int:
         # interrupted — before drain mode starts changing it.
         if flight is not None:
             flight.dump("sigterm")
-        if clients is not None or controller is not None:
+        if coordinator is not None:
+            # Coordinator mode: clearing the feed (below) stops new
+            # admissions; in-flight requests finish wherever they are —
+            # draining the decode fleet here would strand pages exported
+            # by still-running prefill workers.
+            pass
+        elif clients is not None or controller is not None:
             # Remote or elastic fleet: cooperative drain THROUGH the
             # gateway so queued work migrates between replicas instead
             # of dying with this process's view of them (under
@@ -604,13 +726,13 @@ def main(argv: list[str] | None = None) -> int:
             engine, host="0.0.0.0", port=args.metrics_port,
             advertise_host=args.advertise_host, logger=logger,
             heartbeat_dir=args.heartbeat_dir, rank=args.replica_rank,
-            flight=flight).start()
+            role=args.role, flight=flight).start()
         if args.port_file:
             with open(args.port_file, "w") as f:
                 f.write(f"{server.port}\n")
         logger.emit("start", role="replica_server", port=server.port,
                     replica=engine.replica_id, preset=args.preset,
-                    num_slots=args.slots)
+                    serve_role=args.role, num_slots=args.slots)
         while not server.shutting_down:
             if drain_requested and server.drained:
                 break
@@ -639,9 +761,11 @@ def main(argv: list[str] | None = None) -> int:
             bridge.gateway_collector(registry, gateway)
             if controller is not None:
                 bridge.autoscale_collector(registry, controller)
-        else:
+        elif engine is not None and coordinator is None:
             # Per-tenant labeled gauges are per-scheduler; with replicas
-            # each engine has its own and the labels would collide.
+            # each engine has its own and the labels would collide (the
+            # coordinator and remote modes both fan out over several
+            # schedulers, so they skip the per-tenant surface too).
             bridge.sched_collector(registry, engine.queue)
         exporter = MetricsExporter(
             registry, port=args.metrics_port,

@@ -93,7 +93,7 @@ from k8s_distributed_deeplearning_tpu.parallel import sharding as sharding_lib
 from k8s_distributed_deeplearning_tpu.serve.page_pool import PagePool
 from k8s_distributed_deeplearning_tpu.serve.prefix_cache import PrefixCache
 from k8s_distributed_deeplearning_tpu.serve.request import (
-    EngineDraining, QueueFull, Request, RequestOutput)
+    EngineDraining, QueueFull, Request, RequestOutput, SamplingParams)
 from k8s_distributed_deeplearning_tpu.serve.sched import (
     TenantConfig, TenantScheduler)
 from k8s_distributed_deeplearning_tpu.telemetry.trace import Tracer
@@ -470,12 +470,38 @@ def _tp_programs_for(local_model, mesh, param_specs, cache_specs, *,
     return progs
 
 
+def _page_bucket(n: int) -> int:
+    """Power-of-two bucket for a KV transfer's page count: gather/scatter
+    programs compile once per bucket (logarithmic in pool size), with the
+    pad lanes pointed at page 0 — the scratch page, where reads are
+    harmless and writes are the pool's designated garbage sink."""
+    b = 1
+    while b < n:
+        b *= 2
+    return b
+
+
+# KV page shipping (graftsplit): move pool pages BY VALUE between engines.
+# One gather program stages a slot's pages to the host on the exporter;
+# one scatter program adopts the staged values into freshly allocated
+# pages on the importer. Page indices are a traced operand, so the
+# programs compile per (leaf shape, index bucket) — never per transfer.
+@jax.jit
+def _gather_pages_program(leaf, idx):
+    return jnp.take(leaf, idx, axis=-3)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _scatter_pages_program(leaf, vals, idx):
+    return leaf.at[..., idx, :, :].set(vals)
+
+
 class _InFlight:
     """Host-side record for the request occupying a slot."""
 
     __slots__ = ("req", "tokens", "t_submit", "t_admit", "t_first",
                  "cached_prompt_tokens", "prefill_chunks", "grow_left",
-                 "spec_proposed", "spec_accepted")
+                 "spec_proposed", "spec_accepted", "imported")
 
     def __init__(self, req: Request, first_token: int, t_admit: float):
         self.req = req
@@ -488,6 +514,8 @@ class _InFlight:
         self.grow_left = 0       # reserved-but-unallocated decode pages
         self.spec_proposed = 0   # draft tokens proposed for this request
         self.spec_accepted = 0   # draft tokens accepted AND emitted
+        self.imported = False    # adopted via import_request_kv: this slot
+        # never popped the local queue, so no scheduler slot is owed back
 
     def __repr__(self):
         return (f"_InFlight({self.req.request_id}, "
@@ -610,7 +638,7 @@ class ServeEngine:
                  replica_id: str | None = None,
                  draft_model=None, draft_params: PyTree | None = None,
                  spec_k: int = 0, flight: "Any | None" = None,
-                 tp: int = 0):
+                 tp: int = 0, prefill_only: bool = False):
         if num_slots < 2:
             raise ValueError(f"num_slots must be >= 2, got {num_slots}")
         cfg = getattr(model, "cfg", None)
@@ -639,6 +667,12 @@ class ServeEngine:
                 "speculative decoding needs BOTH a draft model and "
                 f"spec_k >= 1 (got draft_model={draft_model!r}, "
                 f"spec_k={spec_k})")
+        if prefill_only and spec_k:
+            raise ValueError(
+                "prefill_only is incompatible with speculative decoding "
+                "(spec_k > 0): exported KV blobs carry only the target "
+                "arena, and a prefill worker never decodes — run the "
+                "draft on the decode workers instead")
         if draft_model is not None:
             if draft_params is None:
                 raise ValueError("draft_model set but draft_params is None")
@@ -711,6 +745,14 @@ class ServeEngine:
             # fall out of the hook list on their own.
             _faults.add_fire_hook(self)
         self._draining = False
+        # Disaggregated prefill role ("graftsplit"): admission + chunked
+        # prefill run normally, but a slot that completes admission is
+        # immediately exported (pages staged by value, slot freed) instead
+        # of entering decode — the coordinator drains take_exports() and
+        # ships each blob to a decode worker. A prefill_only engine is
+        # driven by its coordinator, never by run().
+        self.prefill_only = bool(prefill_only)
+        self._exports: list[dict] = []
         self.queue = TenantScheduler(tenants, default_max_queue=max_queue)
         # Page geometry: the trie's block size IS the pool's page size
         # (one trie node = one page), and it applies whether or not the
@@ -919,8 +961,11 @@ class ServeEngine:
         """True while any work remains: queued requests, prefills in
         progress, or occupied decode slots. THE loop condition for
         callers driving :meth:`step` (in-progress prefills hold no slot
-        entry, so checking queue+slots alone would exit early)."""
-        return bool(len(self.queue) or self._pending
+        entry, so checking queue+slots alone would exit early). A
+        prefill-only engine also counts staged exports awaiting pickup —
+        they hold client requests, so draining before the coordinator
+        collects them would lose work."""
+        return bool(len(self.queue) or self._pending or self._exports
                     or any(s is not None for s in self._slots))
 
     def occupied_slots(self) -> int:
@@ -991,6 +1036,266 @@ class ServeEngine:
                 return self._finish(slot, reason)
         return None
 
+    # ---------------------------------------------- KV page shipping API
+    # Disaggregated serving ("graftsplit", serve/disagg.py): a request's
+    # KV pages move BY VALUE between engines — host-staged gathers on the
+    # exporter, host-staged scatters into freshly allocated pages on the
+    # importer — so the two pools never share device buffers and the
+    # same blob survives a process boundary (serve/disagg.py owns the
+    # wire codec). Works post-admission at ANY decode cursor: the
+    # prefill→decode handoff exports right after admission, and the
+    # gateway's live-migration path exports mid-decode.
+
+    def take_exports(self) -> list[dict]:
+        """Hand over (and clear) the KV export blobs a prefill-only
+        engine staged — the coordinator's pickup point after each
+        :meth:`step`."""
+        out, self._exports = self._exports, []
+        return out
+
+    def export_request_kv(self, request_id: str) -> dict:
+        """Stage an occupied slot's KV state to the host and release the
+        slot WITHOUT finishing the request — it continues on whichever
+        engine imports the blob. The blob carries everything a decode
+        needs to resume bit-identically: prompt + emitted tokens, the KV
+        cursor, the next input token, per-slot sampling registers, the
+        chained PRNG key, and the written pages of every cache leaf (by
+        value). Raises KeyError for a request not occupying a slot
+        (queued/mid-prefill requests have nothing worth shipping — cancel
+        and resubmit those), and ValueError on a speculative engine (the
+        draft arena is not shipped)."""
+        if self.spec_k:
+            raise ValueError(
+                "export_request_kv on a speculative engine: the draft "
+                "arena's KV is not shipped, so the import side could not "
+                "verify drafts — disable spec_k or migrate by token "
+                "resubmission instead")
+        slot = next((i for i, fl in enumerate(self._slots)
+                     if fl is not None
+                     and fl.req.request_id == request_id), None)
+        if slot is None:
+            raise KeyError(
+                f"request {request_id!r} does not occupy a decode slot "
+                "(only admitted requests have KV pages to export)")
+        fl = self._slots[slot]
+        req, sp = fl.req, fl.req.sampling
+        bt = self.page_tokens
+        kv_len = int(self._kv_lens[slot])
+        nb = -(-kv_len // bt)
+        pages = [int(self._tables[slot, j]) for j in range(nb)]
+        idx = np.zeros(_page_bucket(nb), np.int32)
+        idx[:nb] = pages
+        idx = jnp.asarray(idx)
+        leaves, _ = jax.tree_util.tree_flatten(self._cache)
+        # graftlint: disable=host-sync — staging by value IS the point:
+        # the blob must survive this engine (and this process).
+        staged = [np.ascontiguousarray(
+            np.asarray(_gather_pages_program(leaf, idx))[..., :nb, :, :])
+            for leaf in leaves]
+        blob = {
+            "request_id": req.request_id,
+            "prompt": [int(t) for t in req.prompt],
+            "max_new_tokens": int(req.max_new_tokens),
+            "emitted": [int(t) for t in fl.tokens],
+            "kv_len": kv_len,
+            "next_token": int(self._tokens[slot]),
+            "key": np.array(self._keys[slot], np.uint32),
+            "temperature": float(sp.temperature),
+            "top_k": int(sp.top_k),
+            "top_p": float(sp.top_p),
+            "seed": int(req.seed),
+            "tenant": req.tenant,
+            "deadline_s": req.deadline_s,
+            "trace_id": req.trace_id,
+            "t_submit": fl.t_submit,
+            "t_admit": fl.t_admit,
+            "t_first": fl.t_first,
+            "cached_prompt_tokens": fl.cached_prompt_tokens,
+            "prefill_chunks": fl.prefill_chunks,
+            "page_tokens": bt,
+            "n_pages": nb,
+            "pages": staged,
+        }
+        # Release the slot WITHOUT the terminal path: no on_finish, no
+        # completion stats — the request is alive, just elsewhere now.
+        self._slots[slot] = None
+        self._tokens[slot] = self.pad_id
+        self._kv_lens[slot] = 0
+        self._temps[slot] = 0.0
+        self._top_ks[slot] = 0
+        self._top_ps[slot] = 1.0
+        self._release_slot_pages(slot, fl.grow_left)
+        if not fl.imported:
+            self.queue.release(req)
+        self.stats.record_disagg_export(
+            pages=nb, nbytes=sum(v.nbytes for v in staged))
+        self._record_pool_gauges()
+        return blob
+
+    def _free_slot(self) -> int | None:
+        for slot in range(self.num_slots):
+            if self._slots[slot] is None and slot not in self._pending:
+                return slot
+        return None
+
+    def _import_need(self, blob: dict) -> tuple[int, int]:
+        """(shipped pages, remaining growth reservation) an import costs.
+        Growth is recomputed from scratch — the exporter may have already
+        claimed growth pages it never wrote (they are not shipped), so
+        its remaining reservation undercounts what this pool must hold."""
+        nb = int(blob["n_pages"])
+        total = -(-(len(blob["prompt"]) + int(blob["max_new_tokens"]) - 1)
+                  // self.page_tokens)
+        return nb, max(0, total - nb)
+
+    def can_import(self, blob: dict) -> bool:
+        """True when :meth:`import_request_kv` would succeed right now:
+        not draining, page geometry matches, a free slot exists, and the
+        pool covers the shipped pages plus remaining decode growth
+        (evicting unpinned trie pages if that closes the gap)."""
+        if (self._draining or self.spec_k
+                or int(blob["page_tokens"]) != self.page_tokens):
+            return False
+        if (len(blob["prompt"]) + int(blob["max_new_tokens"])
+                > self.max_seq_len):
+            return False
+        if self._free_slot() is None:
+            return False
+        nb, grow = self._import_need(blob)
+        while self.pool.available() < nb + grow:
+            if (self.prefix_cache is None
+                    or not self.prefix_cache.evict_lru_unpinned()):
+                return False
+        return True
+
+    def import_request_kv(self, blob: dict,
+                          request: Request | None = None) -> int:
+        """Adopt an exported request: allocate pages under the
+        ``imported`` owner tag, scatter the staged KV by value, install
+        the slot registers, and resume decoding from the shipped cursor —
+        bit-identical to the uninterrupted run (the chained PRNG key and
+        next input token travel in the blob). *request* (optional) is the
+        live Request object to attach — the in-process path passes it so
+        streaming callbacks survive the hop; when None (the wire path) a
+        fresh Request is rebuilt from the blob. Emitted tokens are NOT
+        re-fired through ``on_token``. Returns the slot index; raises
+        EngineDraining/ValueError/RuntimeError when the blob cannot be
+        adopted here (gate with :meth:`can_import`)."""
+        if self._draining:
+            raise EngineDraining(
+                f"engine{f' {self.replica_id!r}' if self.replica_id else ''}"
+                " is draining — importing nothing new "
+                f"(request {blob.get('request_id')})")
+        if self.spec_k:
+            raise ValueError(
+                "import_request_kv on a speculative engine: the blob "
+                "carries no draft-arena KV to verify drafts against")
+        if int(blob["page_tokens"]) != self.page_tokens:
+            raise ValueError(
+                f"page geometry mismatch: blob pages hold "
+                f"{blob['page_tokens']} tokens, this pool's hold "
+                f"{self.page_tokens} — disagg roles must share "
+                "prefix_block_tokens/min_bucket")
+        emitted = [int(t) for t in blob["emitted"]]
+        if not emitted:
+            raise ValueError("blob has no emitted tokens — nothing was "
+                             "admitted, resubmit the prompt instead")
+        req = request
+        if req is None:
+            req = Request(
+                prompt=[int(t) for t in blob["prompt"]],
+                max_new_tokens=int(blob["max_new_tokens"]),
+                sampling=SamplingParams(
+                    temperature=float(blob["temperature"]),
+                    top_k=int(blob["top_k"]),
+                    top_p=float(blob["top_p"])),
+                request_id=str(blob["request_id"]),
+                seed=int(blob["seed"]),
+                tenant=blob.get("tenant") or "default",
+                deadline_s=blob.get("deadline_s"),
+                trace_id=blob.get("trace_id") or None)
+        n = len(req.prompt)
+        if self.eos_id is not None and emitted[-1] == self.eos_id:
+            raise ValueError(
+                f"request {req.request_id} already emitted EOS — it is "
+                "terminal, not importable")
+        if len(emitted) >= req.max_new_tokens:
+            raise ValueError(
+                f"request {req.request_id} already emitted "
+                f"{len(emitted)}/{req.max_new_tokens} tokens — terminal, "
+                "not importable")
+        if n + req.max_new_tokens > self.max_seq_len:
+            raise ValueError(
+                f"prompt ({n}) + max_new_tokens ({req.max_new_tokens}) "
+                f"exceeds this engine's max_seq_len ({self.max_seq_len})")
+        slot = self._free_slot()
+        if slot is None:
+            raise RuntimeError("no free slot to import into — gate with "
+                               "can_import()")
+        nb, grow = self._import_need(blob)
+        kv_len = int(blob["kv_len"])
+        while self.pool.available() < nb + grow:
+            if (self.prefix_cache is None
+                    or not self.prefix_cache.evict_lru_unpinned()):
+                raise RuntimeError(
+                    f"pool cannot cover import: need {nb} shipped + "
+                    f"{grow} growth pages, {self.pool.available()} "
+                    "available — gate with can_import()")
+        leaves, treedef = jax.tree_util.tree_flatten(self._cache)
+        staged = blob["pages"]
+        if len(staged) != len(leaves):
+            raise ValueError(
+                f"blob has {len(staged)} cache leaves, this engine's "
+                f"pool has {len(leaves)} — different model geometry")
+        pages = self.pool.alloc(nb, owner="imported")
+        self.pool.reserve(grow)
+        nbp = _page_bucket(nb)
+        idx = np.zeros(nbp, np.int32)
+        idx[:nb] = pages
+        idx = jnp.asarray(idx)
+        new_leaves = []
+        nbytes = 0
+        for leaf, vals in zip(leaves, staged):
+            vals = np.asarray(vals)
+            want = leaf.shape[:-3] + (nb,) + leaf.shape[-2:]
+            if vals.shape != want:
+                raise ValueError(
+                    f"staged leaf shape {vals.shape} != expected {want} "
+                    "— different model geometry")
+            nbytes += vals.nbytes
+            if nbp != nb:
+                pad = np.zeros(vals.shape[:-3] + (nbp - nb,)
+                               + vals.shape[-2:], vals.dtype)
+                vals = np.concatenate([vals, pad], axis=-3)
+            new_leaves.append(_scatter_pages_program(
+                leaf, jnp.asarray(vals, leaf.dtype), idx))
+        self._cache = jax.tree_util.tree_unflatten(treedef, new_leaves)
+        row = self._tables[slot]
+        row[:] = 0
+        row[:nb] = pages
+        now = time.perf_counter()
+        fl = _InFlight(req, emitted[0], now)
+        fl.tokens = emitted
+        fl.imported = True
+        fl.grow_left = grow
+        fl.t_submit = float(blob.get("t_submit") or now)
+        fl.t_admit = float(blob.get("t_admit") or now)
+        fl.t_first = float(blob.get("t_first") or now)
+        fl.cached_prompt_tokens = int(blob.get("cached_prompt_tokens", 0))
+        fl.prefill_chunks = int(blob.get("prefill_chunks", 0))
+        req._t_submit = fl.t_submit
+        req._finished = False        # re-arm the exactly-once latch
+        self._slots[slot] = fl
+        self._tokens[slot] = int(blob["next_token"])
+        self._kv_lens[slot] = kv_len
+        self._temps[slot] = req.sampling.temperature
+        self._top_ks[slot] = req.sampling.top_k
+        self._top_ps[slot] = req.sampling.top_p
+        self._keys[slot] = np.asarray(blob["key"], np.uint32)
+        self.stats.record_disagg_import(pages=nb, nbytes=nbytes)
+        self._record_pool_gauges()
+        return slot
+
     def step(self) -> list[RequestOutput]:
         """One serving iteration: admit queued requests into free slots
         (page-budget permitting), run at most ``prefill_chunk_tokens``
@@ -1036,6 +1341,17 @@ class ServeEngine:
         if flight_on and self.last_step_prefill_tokens:
             self._last_prefill_ms = round(
                 (time.perf_counter() - t_pf) * 1e3, 3)
+        if self.prefill_only:
+            # Disaggregated prefill role: every slot that completed
+            # admission this step is exported instead of decoded. Requests
+            # that finished AT admission (EOS first token / 1-token
+            # budget) are already terminal in ``outputs`` and never ship.
+            for slot, fl in enumerate(self._slots):
+                if fl is not None:
+                    self._exports.append(
+                        self.export_request_kv(fl.req.request_id))
+            self._step_epilogue()
+            return outputs
         active = sum(s is not None for s in self._slots)
         if active == 0:
             self._step_epilogue()
@@ -1771,7 +2087,10 @@ class ServeEngine:
         self._release_slot_pages(slot, fl.grow_left)
         self.stats.record_completion(latency_s=out.latency_s,
                                      n_tokens=len(out.tokens), reason=reason)
-        self.queue.release(fl.req)
+        if not fl.imported:
+            # Imported requests never popped this engine's queue, so no
+            # tenant slot is owed back here (the exporter released its own).
+            self.queue.release(fl.req)
         self._emit_request_trace(fl.req, out)
         self._notify_finish(fl.req, reason)
         return out

@@ -710,9 +710,62 @@ class ServeGateway:
         if kill:
             h.engine.shutdown()
         for g in victims:
+            if not kill and self._migrate_shipped(g, h):
+                continue        # pages moved by value: no re-prefill
             if not kill:
                 h.engine.cancel(g.req.request_id, "migrated")
             self._migrate(g, from_rid=h.rid)
+
+    def _migrate_shipped(self, g: _GwRequest, h: _Replica) -> bool:
+        """Drain-path migration upgrade: when the source replica is
+        ALIVE and in-process, move the request's KV pages by value
+        (``export_request_kv`` -> ``import_request_kv``) instead of
+        re-prefilling ``prompt + emitted`` on the target. Token
+        resubmission (:meth:`_migrate`) stays the crash-path fallback —
+        any failure here simply returns False and the caller takes it
+        (the emitted cursor in *g* is authoritative either way, so the
+        client stream splices bit-identically on both paths)."""
+        if g.finished or any(sh.alive for sh in g.shadows.values()):
+            return False
+        src = h.engine
+        if not hasattr(src, "export_request_kv"):
+            return False        # remote replica: crash-path resume only
+        target = self._route({h.rid})
+        if target is None or not hasattr(target.engine,
+                                         "import_request_kv"):
+            return False
+        try:
+            blob = src.export_request_kv(g.req.request_id)
+        except (KeyError, ValueError):
+            return False        # queued/mid-prefill or speculative slot
+        sreq = dataclasses.replace(g.req, migrated_from=h.rid,
+                                   _finished=False, _requeued=False)
+        sh = _Shadow(target.rid, sreq)
+        sreq.on_token = (lambda tok, g=g, sh=sh:
+                         self._on_shadow_token(g, sh, tok))
+        sreq.on_finish = (lambda reason, g=g, sh=sh:
+                          self._on_shadow_finish(g, sh, reason))
+        try:
+            if not target.engine.can_import(blob):
+                raise EngineDraining("target cannot adopt")
+            target.engine.import_request_kv(blob, request=sreq)
+        except (EngineDraining, ValueError, RuntimeError):
+            # The exported slot is gone either way — the blob is host
+            # memory only, so dropping it leaks nothing, and _migrate
+            # resumes from g.emitted through normal admission.
+            return False
+        g.shadows[target.rid] = sh
+        g.winner = sh           # continues the client cursor
+        g.t_dispatch = self._clock()
+        g.migrations += 1
+        self.stats.record_gateway_migration()
+        if self.logger is not None:
+            self.logger.emit("gateway_migrated",
+                             request_id=g.req.request_id,
+                             from_replica=h.rid, to_replica=target.rid,
+                             tokens_emitted=len(g.emitted),
+                             shipped_pages=int(blob["n_pages"]))
+        return True
 
     def _migrate(self, g: _GwRequest, *, from_rid: str) -> None:
         """Resubmit one client request elsewhere as prompt + cursor.

@@ -38,7 +38,11 @@ import numpy as np
 # the trie's reference is the one that outlives the slot. "draft" exists
 # for a future separately-allocated draft arena; today the draft cache
 # shares the target's pages (same indices, same tables), so it stays 0.
-OWNERS = ("free", "slot", "trie", "draft", "scratch")
+# "imported" marks pages adopted from another engine's pool via KV page
+# shipping (disaggregated prefill→decode handoff) — same lifecycle as
+# "slot", but the ledger keeps the provenance visible so a postmortem can
+# tell locally-prefilled memory from shipped-in memory.
+OWNERS = ("free", "slot", "trie", "draft", "scratch", "imported")
 _OWNER_CODE = {name: i for i, name in enumerate(OWNERS)}
 
 

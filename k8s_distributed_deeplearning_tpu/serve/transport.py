@@ -62,6 +62,31 @@ The server's dispatch ledger retains terminal records for the life of
 the process (bounded by requests served): a record must outlive its
 request so a retried submit whose first attempt both landed AND
 finished still deduplicates.
+
+Disaggregated serving (graftsplit, ``serve/disagg.py``) rides the same
+surface with three additions:
+
+- **Role beacons.** A server advertises its *role* ("decode" or
+  "prefill") as a heartbeat extra; :func:`discover_replica_clients`
+  filters on it (default ``role="decode"``) so a gateway or autoscale
+  backend discovering a shared heartbeat directory never adopts a
+  prefill worker as a decode replica.
+- **``/pages``** — chunked, idempotent KV page shipping. Chunks carry a
+  deterministic transfer key; the server stages raw chunk text (never
+  pool pages — an abandoned transfer cannot leak), adopts the blob via
+  ``engine.import_request_kv`` when the last chunk lands, and retains
+  the adoption result in a transfer ledger so re-sent chunks after an
+  ambiguous failure answer ``duplicate: true`` instead of adopting
+  twice. The adopted request is registered under the transfer key as a
+  dispatch record, so the shipping client streams its tokens through
+  the ordinary ``/poll`` path. The ``transport_pages`` fault site fires
+  client-side before each chunk leaves.
+- **``/exports``** — the prefill worker's pickup point: finished
+  prefills (``engine.take_exports()``) are held server-side, encoded,
+  until the polling client acknowledges them; a lost response re-
+  delivers (the client's seen-set dedups), an acknowledged blob is
+  dropped. Matching dispatch records finish with reason ``exported`` —
+  a handoff marker, not a client-visible terminal.
 """
 from __future__ import annotations
 
@@ -73,6 +98,10 @@ import urllib.request
 from typing import Callable
 
 from k8s_distributed_deeplearning_tpu import faults as _faults
+from k8s_distributed_deeplearning_tpu.serve.disagg import (
+    decode_blob, encode_blob, request_from_blob)
+from k8s_distributed_deeplearning_tpu.serve.disagg import (
+    transfer_key as _blob_transfer_key)
 from k8s_distributed_deeplearning_tpu.serve.engine import ServeEngine
 from k8s_distributed_deeplearning_tpu.serve.request import (
     EngineDraining, QueueFull, Request, SamplingParams)
@@ -186,15 +215,26 @@ class ReplicaServer:
                  heartbeat_dir: str | None = None, rank: int = 0,
                  heartbeat_interval_s: float = 2.0,
                  idle_wait_s: float = 0.005,
-                 flight=None, handler_timeout: float = 30.0):
+                 flight=None, handler_timeout: float = 30.0,
+                 role: str = "decode"):
         self.engine = engine
         self.logger = logger
         self.flight = flight
         self.stats = engine.stats
+        # Advertised through the heartbeat plane so role-filtered
+        # discovery can tell prefill workers from decode replicas.
+        self.role = str(role)
         self._cond = threading.Condition()
         self._stop = threading.Event()
         self._records: dict[str, _Record] = {}
         self._flushed_ids: list[str] = []
+        # /pages transfer state: in-flight chunk text per transfer key
+        # (strings only — an abandoned transfer holds no pool pages) and
+        # the retained adoption results (the exactly-once ledger).
+        self._page_parts: dict[str, dict[int, str]] = {}
+        self._page_results: dict[str, dict] = {}
+        # /exports hold: encoded blobs awaiting client acknowledgement.
+        self._export_hold: dict[str, dict] = {}
         self._step_error: str | None = None
         self._steps = 0
         self.idle_wait_s = idle_wait_s
@@ -211,6 +251,8 @@ class ReplicaServer:
             "/drain": self._guard(self._h_drain),
             "/load": self._guard(self._h_load),
             "/shutdown": self._guard(self._h_shutdown),
+            "/pages": self._guard(self._h_pages),
+            "/exports": self._guard(self._h_exports),
         }
         self.exporter = MetricsExporter(
             registry, host=host, port=port,
@@ -372,6 +414,94 @@ class ReplicaServer:
             return _reply(200, {"ok": True,
                                 "aborted": [o.request_id for o in outs]})
 
+    def _h_pages(self, method: str, query: str, body: bytes):
+        """One chunk of a KV page transfer. Chunks accumulate as raw
+        text under the client-minted transfer key; the final chunk
+        decodes the blob and adopts it. Adoption results are retained so
+        a re-sent chunk after an ambiguous failure gets the ORIGINAL
+        result back (``duplicate: true``) — adoption is exactly-once per
+        transfer key for the life of the process."""
+        msg = json.loads(body.decode() or "{}")
+        key = str(msg["transfer"])
+        part = int(msg["part"])
+        total = int(msg["parts"])
+        with self._cond:
+            done = self._page_results.get(key)
+            if done is not None:
+                self.stats.record_transport_dedup()
+                if self.flight is not None:
+                    self.flight.record("transport", pages_dedup=key)
+                return _reply(200, {**done, "duplicate": True})
+            if self._step_error is not None:
+                return _reply(500, {"error": self._step_error})
+            parts = self._page_parts.setdefault(key, {})
+            parts[part] = str(msg["data"])
+            if len(parts) < total:
+                return _reply(200, {"ok": True, "adopted": False,
+                                    "received": len(parts)})
+            try:
+                blob = decode_blob(json.loads(
+                    "".join(parts[i] for i in range(total))))
+                req = request_from_blob(blob)
+            except (KeyError, TypeError, ValueError) as e:
+                self._page_parts.pop(key, None)
+                return _reply(400, {"error": repr(e)})
+            rec = _Record(req)
+            req.on_token = rec.tokens.append
+            req.on_finish = (
+                lambda reason, rec=rec: setattr(rec, "finished", reason))
+            if not self.engine.can_import(blob):
+                # Definitive no (slots/pages right now) — chunks are
+                # kept, so a later retry of the same key is cheap.
+                return _reply(429, {
+                    "error": "cannot adopt: no free slot or insufficient "
+                             "KV pages"})
+            try:
+                slot = self.engine.import_request_kv(blob, request=req)
+            except EngineDraining as e:
+                return _reply(503, {"error": str(e)})
+            except ValueError as e:
+                self._page_parts.pop(key, None)
+                return _reply(400, {"error": str(e)})
+            except RuntimeError as e:
+                return _reply(429, {"error": str(e)})
+            self._page_parts.pop(key, None)
+            result = {"ok": True, "adopted": True, "slot": int(slot),
+                      "request_id": req.request_id}
+            self._page_results[key] = result
+            # Pollable under the transfer key: the shipping client
+            # streams the adopted request's NEW tokens from cursor 0
+            # (emitted-so-far traveled in the blob, not the record).
+            self._records[key] = rec
+            if self.flight is not None:
+                self.flight.record("transport", pages_adopted=key,
+                                   pages=int(blob["n_pages"]))
+            self._cond.notify_all()
+            return _reply(200, result)
+
+    def _h_exports(self, method: str, query: str, body: bytes):
+        """Prefill-side pickup: acknowledge-then-hand-over. Acked blobs
+        are dropped; everything the engine exported since last call
+        joins the hold (marking its dispatch record ``exported`` so the
+        submitting client's poll sees a handoff terminal); the FULL hold
+        is returned — a lost response re-delivers and the client's
+        seen-set dedups, so no export is ever lost or double-shipped."""
+        msg = json.loads(body.decode() or "{}")
+        with self._cond:
+            if self._step_error is not None:
+                return _reply(500, {"error": self._step_error})
+            for k in msg.get("ack", ()):
+                self._export_hold.pop(str(k), None)
+            for blob in self.engine.take_exports():
+                self._export_hold[_blob_transfer_key(blob)] = (
+                    encode_blob(blob))
+                for rec in self._records.values():
+                    if (rec.req.request_id == blob["request_id"]
+                            and rec.finished is None):
+                        rec.finished = "exported"
+            return _reply(200, {"exports": dict(self._export_hold),
+                                **self._health_fields()})
+
     def _health_fields(self) -> dict:
         """Piggybacked on every poll/drain/load response: the same
         signals the /metrics health scrape carries, at zero extra
@@ -421,7 +551,8 @@ class ReplicaServer:
         now = time.monotonic()
         if force or now - self._hb_last >= self._hb_interval:
             self._hb_last = now
-            self._hb.beat(step=self._steps, metrics_addr=self.address)
+            self._hb.beat(step=self._steps, metrics_addr=self.address,
+                          role=self.role)
 
     def serve_forever(self, poll_s: float = 0.05) -> None:
         """Block until :meth:`close` (or /shutdown) — the CLI's replica
@@ -538,6 +669,10 @@ class ReplicaClient:
         self._seq = 0
         self._streams: dict[str, _Stream] = {}
         self._poll_failures = 0
+        # /exports bookkeeping: keys to acknowledge on the next pickup
+        # and keys already handed to the caller (re-delivery dedup).
+        self._export_acks: list[str] = []
+        self._seen_exports: set[str] = set()
         self._health: dict = {
             "busy": False, "load": 0, "draining": False, "drained": False,
             "occupied_slots": 0, "num_slots": 1, "queue_depth": 0,
@@ -549,19 +684,27 @@ class ReplicaClient:
     # ------------------------------------------------------------- wire
 
     def _call(self, path: str, payload: dict, *,
-              timeout: float | None = None) -> dict:
+              timeout: float | None = None,
+              site: str = "transport_send") -> dict:
         """POST *payload* with bounded full-jitter retries. Fires the
-        ``transport_send`` fault site before every attempt (inside the
-        retry loop, so count-scoped faults expire across retries).
-        Server-mapped statuses surface as their typed exceptions and
-        are never retried; only OSError (connection refused/reset,
-        timeouts, injected network faults) is transient."""
+        *site* fault site before every attempt (inside the retry loop,
+        so count-scoped faults expire across retries) — the control
+        surface fires ``transport_send``, page shipping fires
+        ``transport_pages``. Server-mapped statuses surface as their
+        typed exceptions and are never retried; only OSError (connection
+        refused/reset, timeouts, injected network faults) is
+        transient."""
         data = json.dumps(payload).encode()
 
         def attempt() -> dict:
             inj = _faults.active()
             if inj is not None:
-                inj.fire("transport_send")
+                # Literal site names: the fault-site lint pass resolves
+                # live hooks from string constants at .fire() calls.
+                if site == "transport_pages":
+                    inj.fire("transport_pages")
+                else:
+                    inj.fire("transport_send")
             httpreq = urllib.request.Request(
                 self.endpoint + path, data=data,
                 headers={"Content-Type": _JSON}, method="POST")
@@ -732,6 +875,58 @@ class ReplicaClient:
                 f"(restarted?): {sorted(unknown)[:4]}")
         return []
 
+    # ------------------------------------------------ KV page shipping
+
+    def ship_pages(self, blob: dict, *, req: Request | None = None,
+                   transfer_key: str | None = None,
+                   chunk_chars: int = 262_144) -> dict:
+        """Ship one exported KV blob to this replica over ``/pages``,
+        chunked. The transfer key defaults to the blob's deterministic
+        ``request_id:kv_len`` key — callers retrying an ambiguous
+        failure MUST reuse the same key (the server's ledger makes the
+        retry exactly-once). Raises the server's typed rejections
+        (QueueFull = cannot adopt, EngineDraining, ValueError) or
+        OSError after exhausted retries on a chunk. *req*, when given,
+        is registered as a poll stream on success so the adopted
+        request's tokens keep streaming to its callbacks."""
+        key = transfer_key or _blob_transfer_key(blob)
+        text = json.dumps(encode_blob(blob))
+        parts = ([text[i:i + chunk_chars]
+                  for i in range(0, len(text), chunk_chars)] or [""])
+        body: dict = {}
+        for i, part in enumerate(parts):
+            body = self._call(
+                "/pages",
+                {"transfer": key, "part": i, "parts": len(parts),
+                 "data": part},
+                site="transport_pages")
+            if body.get("duplicate") or body.get("adopted"):
+                break      # ledger answered early: transfer already done
+        if not body.get("adopted"):
+            raise RuntimeError(
+                f"page transfer {key} not adopted by "
+                f"{self.replica_id or self.endpoint}: {body}")
+        if req is not None:
+            self._streams[key] = _Stream(req)
+        return body
+
+    def take_remote_exports(self) -> list[dict]:
+        """Drain the replica's export hold (prefill role): acknowledge
+        everything received last call, decode and return only blobs not
+        seen before. A lost response costs nothing — the hold re-
+        delivers until acked, and the seen-set drops repeats."""
+        body = self._call("/exports", {"ack": list(self._export_acks)})
+        self._apply_health(body)
+        held = body.get("exports", {})
+        self._export_acks = list(held.keys())
+        fresh: list[dict] = []
+        for key, doc in held.items():
+            if key in self._seen_exports:
+                continue
+            self._seen_exports.add(key)
+            fresh.append(decode_blob(doc))
+        return fresh
+
     def busy(self) -> bool:
         return bool(self._streams) or bool(self._health["busy"])
 
@@ -801,13 +996,22 @@ class ReplicaClient:
 
 def discover_replica_clients(heartbeat_dir: str, *,
                              stale_after_s: float | None = None,
+                             role: str | None = "decode",
                              **kwargs) -> list[ReplicaClient]:
     """One :class:`ReplicaClient` per ``metrics_addr`` advertised in
     *heartbeat_dir* (the :class:`ReplicaServer` heartbeat extra) — the
     no-static-config path to a remote gateway fleet. *stale_after_s*
     drops beacons older than that age (a crashed replica's leftover file
     is not an endpoint); *kwargs* forward to every client (shared
-    stats/logger, timeouts)."""
+    stats/logger, timeouts).
+
+    *role* keeps the fleet honest under disaggregation: the default
+    ``"decode"`` returns only decode replicas (beacons with no role
+    extra count as decode — every pre-disagg server), so a gateway or
+    autoscale backend sharing a heartbeat directory with prefill
+    workers never adopts one as a decode replica. Pass ``"prefill"``
+    for the prefill fleet, or None for everything."""
     return [ReplicaClient(ep, **kwargs)
             for ep in discover_endpoints(heartbeat_dir,
-                                         stale_after_s=stale_after_s)]
+                                         stale_after_s=stale_after_s,
+                                         role=role)]

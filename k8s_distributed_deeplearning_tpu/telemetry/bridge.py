@@ -169,6 +169,29 @@ def serving_collector(registry: MetricsRegistry,
             "serve_transport_reconnects_total",
             "token streams resumed from their emitted-token cursor "
             "after failed polls"),
+        "serve_disagg_exports_total": registry.gauge(
+            "serve_disagg_exports_total",
+            "requests whose prompt KV pages were exported by a prefill "
+            "worker for cross-role shipping (serve/disagg.py)"),
+        "serve_disagg_imports_total": registry.gauge(
+            "serve_disagg_imports_total",
+            "requests adopted by a decode engine from shipped KV pages "
+            "(freshly allocated under the 'imported' pool owner)"),
+        "serve_disagg_bytes_shipped_total": registry.gauge(
+            "serve_disagg_bytes_shipped_total",
+            "KV page bytes moved by value between prefill and decode "
+            "engines (host-staged, both directions of the transfer)"),
+        "serve_disagg_fallbacks_total": registry.gauge(
+            "serve_disagg_fallbacks_total",
+            "requests the coordinator routed to unified decode-local "
+            "prefill because no prefill worker was healthy (disagg is "
+            "a performance mode, never an availability dependency)"),
+        "serve_disagg_prefill_depth": registry.gauge(
+            "serve_disagg_prefill_depth",
+            "in-flight requests currently held by prefill workers"),
+        "serve_disagg_decode_depth": registry.gauge(
+            "serve_disagg_decode_depth",
+            "in-flight disagg requests currently decoding"),
         "serve_spec_steps_total": registry.gauge(
             "serve_spec_steps_total",
             "speculative (draft-and-verify) decode iterations run"),
@@ -219,6 +242,12 @@ def serving_collector(registry: MetricsRegistry,
                "gateway_migrations": "serve_gateway_migrations_total",
                "gateway_hedges": "serve_gateway_hedges_total",
                "gateway_breaker_trips": "serve_gateway_breaker_trips_total",
+               "disagg_exports": "serve_disagg_exports_total",
+               "disagg_imports": "serve_disagg_imports_total",
+               "disagg_bytes_shipped": "serve_disagg_bytes_shipped_total",
+               "disagg_fallbacks": "serve_disagg_fallbacks_total",
+               "disagg_prefill_depth": "serve_disagg_prefill_depth",
+               "disagg_decode_depth": "serve_disagg_decode_depth",
                "spec_steps": "serve_spec_steps_total",
                "spec_proposed_tokens": "serve_spec_proposed_tokens_total",
                "spec_accepted_tokens": "serve_spec_accepted_tokens_total",

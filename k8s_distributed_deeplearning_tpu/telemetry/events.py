@@ -106,6 +106,16 @@ EVENTS: dict[str, str] = {
     "autoscale_summary": "end-of-run fleet controller snapshot (rounds, "
                          "decision counts, actuation failures, final "
                          "desired/actual replicas)",
+    "disagg_shipped": "a prefill worker's finished KV pages were adopted "
+                      "by a decode worker (request, pages, bytes, "
+                      "kv cursor attached)",
+    "disagg_fallback": "the disagg coordinator routed a request through "
+                       "the unified decode-local prefill path (no healthy "
+                       "prefill worker / no adopter; reason and emitted "
+                       "cursor attached)",
+    "disagg_prefill_down": "a prefill worker died or stopped answering; "
+                           "its in-flight requests are being re-routed "
+                           "through normal decode-side admission",
 }
 
 _SNAKE = re.compile(r"^[a-z][a-z0-9_]*$")

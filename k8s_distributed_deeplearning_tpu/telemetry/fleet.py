@@ -178,7 +178,8 @@ def parse_exposition(text: str) -> dict[str, Family]:
 
 def discover_endpoints(heartbeat_dir: str, *,
                        stale_after_s: float | None = None,
-                       now: float | None = None) -> list[str]:
+                       now: float | None = None,
+                       role: str | None = None) -> list[str]:
     """Endpoints advertised by heartbeat records: any rank whose writer
     passed ``metrics_addr="host:port"`` as a beat extra (the discovery
     path for replicas behind no static config).
@@ -186,7 +187,13 @@ def discover_endpoints(heartbeat_dir: str, *,
     *stale_after_s* (same age logic as :func:`heartbeat.detect_stalls`)
     drops beacons older than that many seconds — a replica that died
     without removing its file is never handed back as a live endpoint.
-    None keeps the historical behaviour (every beacon counts)."""
+    None keeps the historical behaviour (every beacon counts).
+
+    *role* filters on the beacon's ``role`` extra (disaggregated
+    serving advertises "decode" or "prefill"); a beacon WITHOUT a role
+    extra counts as "decode" — every server predating role beacons was
+    a decode replica, so old beacons keep discovering under the new
+    filter. None (default) returns every role."""
     if now is None:
         now = time.time()
     addrs = set()
@@ -195,6 +202,9 @@ def discover_endpoints(heartbeat_dir: str, *,
             continue
         if (stale_after_s is not None
                 and now - float(rec["ts"]) > stale_after_s):
+            continue
+        if (role is not None
+                and str(rec.get("role") or "decode") != role):
             continue
         addrs.add(str(rec["metrics_addr"]))
     return sorted(addrs)

@@ -153,6 +153,17 @@ class ServingStats:
         self.spec_proposed_tokens = 0
         self.spec_accepted_tokens = 0
         self.spec_accept_hist: dict[int, int] = {}
+        # Disaggregated prefill/decode (serve/disagg.py): KV exports
+        # staged off this engine, imports adopted into it, bytes shipped
+        # each way, and unified-path fallbacks the coordinator took when
+        # no prefill worker was healthy. Depth gauges are the
+        # coordinator's latest per-role backlog snapshot.
+        self.disagg_exports = 0
+        self.disagg_imports = 0
+        self.disagg_bytes_shipped = 0
+        self.disagg_fallbacks = 0
+        self.disagg_prefill_depth = 0
+        self.disagg_decode_depth = 0
 
     def _tick(self) -> None:
         now = time.perf_counter()
@@ -273,6 +284,33 @@ class ServingStats:
         self._tick()
         self.transport_reconnects += 1
 
+    def record_disagg_export(self, pages: int, nbytes: int) -> None:
+        """One request's KV pages were staged off this engine (prefill
+        worker handoff, or live page-shipping migration)."""
+        self._tick()
+        self.disagg_exports += 1
+        self.disagg_bytes_shipped += int(nbytes)
+
+    def record_disagg_import(self, pages: int, nbytes: int) -> None:
+        """One exported request was adopted into this engine's pool
+        (pages tagged ``imported``) and resumed decoding."""
+        self._tick()
+        self.disagg_imports += 1
+        self.disagg_bytes_shipped += int(nbytes)
+
+    def record_disagg_fallback(self) -> None:
+        """The coordinator routed one prompt down the unified decode-local
+        prefill path because no prefill worker was healthy (or a shipped
+        transfer failed and the request resumed by token re-prefill)."""
+        self._tick()
+        self.disagg_fallbacks += 1
+
+    def record_disagg_depth(self, prefill: int, decode: int) -> None:
+        """Latest per-role backlog snapshot (coordinator view). NO
+        ``_tick()`` — a gauge refresh is not serving activity."""
+        self.disagg_prefill_depth = int(prefill)
+        self.disagg_decode_depth = int(decode)
+
     def record_completion(self, latency_s: float, n_tokens: int,
                           reason: str) -> None:
         self._tick()
@@ -329,6 +367,12 @@ class ServingStats:
             "transport_retries": self.transport_retries,
             "transport_dedup_hits": self.transport_dedup_hits,
             "transport_reconnects": self.transport_reconnects,
+            "disagg_exports": self.disagg_exports,
+            "disagg_imports": self.disagg_imports,
+            "disagg_bytes_shipped": self.disagg_bytes_shipped,
+            "disagg_fallbacks": self.disagg_fallbacks,
+            "disagg_prefill_depth": self.disagg_prefill_depth,
+            "disagg_decode_depth": self.disagg_decode_depth,
             "spec_steps": self.spec_steps,
             "spec_proposed_tokens": self.spec_proposed_tokens,
             "spec_accepted_tokens": self.spec_accepted_tokens,

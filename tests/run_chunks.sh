@@ -78,13 +78,18 @@ CHUNKS[transport]="tests/test_transport.py"
 # runs jax-free, but the bit-identical mid-decode removal case compiles a
 # real multi-replica fleet — its own chunk so gateway stays under timeout.
 CHUNKS[autoscale]="tests/test_autoscale.py"
+# graftsplit (serve/disagg.py disaggregated prefill/decode): codec and
+# coordinator-routing units run jax-free, but the parity/chaos matrix
+# compiles prefill+decode engines (some behind ReplicaServer threads) —
+# its own chunk so transport/gateway stay under their timeouts.
+CHUNKS[disagg]="tests/test_disagg.py"
 # graftmesh (tensor-parallel serving): the tp=2 parity matrix compiles
 # every engine program three times (tp 0/1/2) under shard_map — its own
 # chunk so serve/spec stay under their timeouts.
 CHUNKS[tp]="tests/test_tp_serve.py"
 CHUNKS[slow1]="tests/test_train_e2e.py tests/test_multiprocess.py"
 CHUNKS[slow2]="tests/test_multihost_train.py tests/test_multihost_llama.py tests/test_train_zoo.py"
-ORDER=(lint core parallel1 parallel2 moe train llama deploy serve sched paged faults graftscope fleet gateway spec flight transport autoscale tp slow1 slow2)
+ORDER=(lint core parallel1 parallel2 moe train llama deploy serve sched paged faults graftscope fleet gateway spec flight transport autoscale disagg tp slow1 slow2)
 
 # --- completeness check: every tests/test_*.py in EXACTLY one chunk ------
 # ...and every declared chunk actually in ORDER: a chunk missing from the

@@ -1,0 +1,755 @@
+"""graftsplit chaos matrix (serve/disagg.py): disaggregated prefill/
+decode serving with cross-role KV page shipping.
+
+Two tiers, mirroring test_transport.py:
+
+- jax-free units: the wire codec (round-trip, host-timestamp stripping,
+  malformed-document rejection, cursor-keyed transfer keys) and the
+  coordinator's routing/fallback state machine against duck-typed fake
+  workers — least-loaded prefill routing, probe failures routed around,
+  dead-worker fallback, the exactly-once wire-ship discipline (retry
+  the SAME target with the SAME key once, NEVER a second target), and
+  the role-filtered discovery surfaces that keep a decode controller
+  from adopting a prefill worker.
+- real-model integration: engine-level export/import round-trip under
+  the ``imported`` owner tag, in-process and over-graftwire coordinator
+  parity against the unified oracle, prefill kill mid-chunk, the
+  ``/pages`` transfer ledger answering duplicates, and the
+  ``transport_pages`` fault site (drop retried transparently; a
+  partition window falls back without double adoption).
+
+The headline acceptance criteria: kill every prefill worker mid-chunk
+and every request still completes bit-identically with zero lost
+requests; an ambiguous page-transfer failure can never double-adopt;
+and no path — happy, faulted, or fallen back — leaks a pool page."""
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from k8s_distributed_deeplearning_tpu import faults
+from k8s_distributed_deeplearning_tpu.faults.plan import Fault, FaultPlan
+from k8s_distributed_deeplearning_tpu.serve.disagg import (
+    DisaggCoordinator, PrefillWorker, RemotePrefillWorker, blob_nbytes,
+    decode_blob, encode_blob, request_from_blob, transfer_key)
+from k8s_distributed_deeplearning_tpu.serve.request import (Request,
+                                                            SamplingParams)
+from k8s_distributed_deeplearning_tpu.utils.metrics import ServingStats
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_plan():
+    faults.deactivate()
+    yield
+    faults.deactivate()
+
+
+class _Events:
+    def __init__(self):
+        self.events = []
+
+    def emit(self, event, **fields):
+        self.events.append((event, fields))
+
+    def names(self):
+        return [e for e, _ in self.events]
+
+
+# ------------------------------------------------------- wire codec units
+
+
+def _fake_blob(request_id="r0", kv_len=40, n_pages=2):
+    """Hand-built export blob with the engine's field inventory — the
+    codec must round-trip it without knowing which engine minted it."""
+    rng = np.random.default_rng(7)
+    return {
+        "request_id": request_id,
+        "prompt": [3, 5, 7, 11],
+        "max_new_tokens": 16,
+        "temperature": 0.0,
+        "top_k": 0,
+        "top_p": 1.0,
+        "seed": 1234,
+        "tenant": "default",
+        "deadline_s": None,
+        "trace_id": "trace-1",
+        "kv_len": kv_len,
+        "n_pages": n_pages,
+        "pages": [rng.standard_normal((2, 8, 1, 4)).astype(np.float32)
+                  for _ in range(n_pages)],
+        "key": np.arange(4, dtype=np.uint32),
+        # Host perf_counter timestamps: MUST NOT travel between processes.
+        "t_submit": 123.4,
+        "t_admit": 124.5,
+        "t_first": 125.6,
+    }
+
+
+def test_codec_round_trip_strips_host_timestamps():
+    blob = _fake_blob()
+    doc = encode_blob(blob)
+    # The wire form is pure JSON — it must survive a real dumps/loads.
+    rt = decode_blob(json.loads(json.dumps(doc)))
+    for k in ("t_submit", "t_admit", "t_first"):
+        assert k not in doc and k not in rt
+    assert rt["request_id"] == "r0" and rt["kv_len"] == 40
+    assert rt["n_pages"] == blob["n_pages"]
+    assert np.array_equal(rt["key"], blob["key"])
+    assert rt["key"].dtype == np.uint32
+    for a, b in zip(rt["pages"], blob["pages"]):
+        assert a.dtype == b.dtype and np.array_equal(a, b)
+    assert blob_nbytes(rt) == blob_nbytes(blob)
+
+
+def test_codec_malformed_document_rejected():
+    doc = encode_blob(_fake_blob())
+    missing = {k: v for k, v in doc.items() if k != "key"}
+    with pytest.raises(KeyError):
+        decode_blob(missing)
+    bad = json.loads(json.dumps(doc))
+    bad["pages"][0]["b64"] = "!!not-base64!!"
+    with pytest.raises(ValueError):
+        decode_blob(bad)
+
+
+def test_transfer_key_is_cursor_keyed():
+    blob = _fake_blob(request_id="req-9", kv_len=40)
+    assert transfer_key(blob) == "req-9:40"
+    # Re-exporting the SAME request after more progress is a legitimately
+    # different transfer — the key must move with the cursor.
+    assert transfer_key({**blob, "kv_len": 56}) == "req-9:56"
+
+
+def test_request_from_blob_rebuilds_sampling_and_identity():
+    req = request_from_blob(_fake_blob())
+    assert req.prompt == [3, 5, 7, 11]
+    assert req.max_new_tokens == 16
+    assert req.request_id == "r0"
+    assert req.seed == 1234
+    assert req.sampling == SamplingParams(temperature=0.0, top_k=0,
+                                          top_p=1.0)
+    assert req.tenant == "default"
+    assert req.trace_id == "trace-1"
+
+
+# ------------------------------------------- coordinator units (jax-free)
+
+
+class _FakePrefill:
+    """Duck-typed prefill worker: exports one single-page blob per
+    submitted request on the next step."""
+
+    def __init__(self, worker_id="p0", load=0.0):
+        self.worker_id = worker_id
+        self.alive = True
+        self._load = load
+        self.submitted = []
+        self._pending = []
+        self.step_error = None
+
+    def submit(self, req, *, requeue=False):
+        self.submitted.append(req.request_id)
+        self._pending.append(req)
+
+    def step(self):
+        if self.step_error is not None:
+            raise self.step_error
+
+    def take_exports(self):
+        blobs = [{"request_id": r.request_id, "kv_len": len(r.prompt),
+                  "n_pages": 1, "pages": [np.zeros((4,), np.float32)],
+                  "key": np.zeros((4,), np.uint32)}
+                 for r in self._pending]
+        self._pending.clear()
+        return blobs
+
+    def load(self):
+        if isinstance(self._load, Exception):
+            raise self._load
+        return self._load
+
+
+class _FakeDecode:
+    """In-process-style decode target (has import_request_kv): one step
+    emits the full token budget of everything it holds."""
+
+    def __init__(self, *, adopts=True, load=0.0):
+        self.draining = False
+        self.adopts = adopts
+        self._load = load
+        self.imported = []
+        self.submitted = []
+        self._active = []
+
+    def load(self):
+        return self._load
+
+    def busy(self):
+        return bool(self._active)
+
+    def can_import(self, blob):
+        return self.adopts
+
+    def import_request_kv(self, blob, *, request=None):
+        self.imported.append(str(blob["request_id"]))
+        self._active.append(request)
+        return 0
+
+    def submit(self, req, *, requeue=False):
+        self.submitted.append(req.request_id)
+        self._active.append(req)
+
+    def step(self):
+        active, self._active = self._active, []
+        for req in active:
+            for _ in range(req.max_new_tokens):
+                req.on_token(5)
+            req.on_finish("length")
+
+
+class _FakeWireDecode:
+    """Wire-style decode target (NO import_request_kv attribute, so the
+    coordinator must go through ship_pages with a transfer key)."""
+
+    def __init__(self, *, fail_ships=0):
+        self.draining = False
+        self.fail_ships = fail_ships
+        self.ship_calls = []
+        self.submitted = []
+        self._active = []
+
+    def load(self):
+        return 0.0
+
+    def busy(self):
+        return bool(self._active)
+
+    def ship_pages(self, blob, *, req=None, transfer_key=None):
+        self.ship_calls.append(transfer_key)
+        if self.fail_ships > 0:
+            self.fail_ships -= 1
+            raise OSError("injected: connection reset mid-transfer")
+        self._active.append(req)
+        return {"ok": True, "adopted": True}
+
+    def submit(self, req, *, requeue=False):
+        self.submitted.append(req.request_id)
+        self._active.append(req)
+
+    def step(self):
+        active, self._active = self._active, []
+        for req in active:
+            for _ in range(req.max_new_tokens):
+                req.on_token(5)
+            req.on_finish("length")
+
+
+def _req(rid, n_prompt=4, max_new=3):
+    return Request(prompt=list(range(1, n_prompt + 1)),
+                   max_new_tokens=max_new, request_id=rid)
+
+
+def test_coordinator_requires_decode_worker():
+    with pytest.raises(ValueError, match="decode"):
+        DisaggCoordinator([], [_FakePrefill()])
+
+
+def test_duplicate_live_request_id_rejected():
+    coord = DisaggCoordinator([_FakeDecode()], [_FakePrefill()])
+    coord.submit(_req("dup"))
+    with pytest.raises(ValueError, match="already live"):
+        coord.submit(_req("dup"))
+
+
+def test_routes_least_loaded_prefill_and_probe_failure_routed_around():
+    heavy = _FakePrefill("heavy", load=5.0)
+    light = _FakePrefill("light", load=1.0)
+    sick = _FakePrefill("sick", load=RuntimeError("probe timeout"))
+    coord = DisaggCoordinator([_FakeDecode()], [heavy, sick, light])
+    coord.submit(_req("a"))
+    assert light.submitted == ["a"]
+    assert heavy.submitted == [] and sick.submitted == []
+
+
+def test_no_prefill_worker_falls_back_with_event():
+    log = _Events()
+    dec = _FakeDecode()
+    coord = DisaggCoordinator([dec], [], stats=ServingStats(), logger=log)
+    outs = coord.run([_req("u0")])
+    assert len(outs) == 1 and outs[0].finish_reason == "length"
+    assert dec.submitted == ["u0"] and dec.imported == []
+    assert coord.stats.disagg_fallbacks == 1
+    fall = [f for n, f in log.events if n == "disagg_fallback"]
+    assert fall and fall[0]["reason"] == "no_prefill_worker"
+    assert fall[0]["tokens_emitted"] == 0
+
+
+def test_prefill_step_exception_marks_down_and_falls_back():
+    log = _Events()
+    pre = _FakePrefill("pw")
+    dec = _FakeDecode()
+    coord = DisaggCoordinator([dec], [pre], logger=log)
+    coord.submit(_req("x0"))
+    pre.step_error = OSError("replica process died")
+    outs = coord.run([])
+    assert len(outs) == 1 and outs[0].finish_reason == "length"
+    assert pre.alive is False
+    assert dec.submitted == ["x0"]          # re-routed, not lost
+    assert coord.stats.disagg_fallbacks == 1
+    assert "disagg_prefill_down" in log.names()
+
+
+def test_kill_prefill_unknown_worker_raises():
+    coord = DisaggCoordinator([_FakeDecode()], [_FakePrefill("pw")])
+    with pytest.raises(ValueError, match="nope"):
+        coord.kill_prefill("nope")
+
+
+def test_ship_skips_non_adopting_decode_worker():
+    log = _Events()
+    full = _FakeDecode(adopts=False, load=0.0)
+    roomy = _FakeDecode(adopts=True, load=9.0)   # heavier but CAN adopt
+    coord = DisaggCoordinator([full, roomy], [_FakePrefill()], logger=log)
+    outs = coord.run([_req("s0")])
+    assert len(outs) == 1
+    assert roomy.imported == ["s0"] and full.imported == []
+    assert coord.stats.disagg_fallbacks == 0
+    shipped = [f for n, f in log.events if n == "disagg_shipped"]
+    assert shipped and shipped[0]["request_id"] == "s0"
+    assert shipped[0]["pages"] == 1
+
+
+def test_no_adopter_anywhere_falls_back():
+    log = _Events()
+    full = _FakeDecode(adopts=False)
+    coord = DisaggCoordinator([full], [_FakePrefill()], logger=log)
+    outs = coord.run([_req("f0")])
+    assert len(outs) == 1 and outs[0].finish_reason == "length"
+    # Fallback went through normal admission on the same worker.
+    assert full.submitted == ["f0"] and full.imported == []
+    fall = [f for n, f in log.events if n == "disagg_fallback"]
+    assert fall and fall[0]["reason"] == "no_decode_adopter"
+
+
+def test_wire_ship_oserror_retries_same_target_same_key_once():
+    flaky = _FakeWireDecode(fail_ships=1)
+    other = _FakeWireDecode()
+    coord = DisaggCoordinator([flaky, other], [_FakePrefill()])
+    outs = coord.run([_req("w0", n_prompt=4)])
+    assert len(outs) == 1 and outs[0].finish_reason == "length"
+    # Ambiguous failure: retried the SAME target with the SAME key —
+    # the second target was never offered the transfer.
+    assert flaky.ship_calls == ["w0:4", "w0:4"]
+    assert other.ship_calls == []
+    assert coord.stats.disagg_fallbacks == 0
+
+
+def test_wire_ship_double_oserror_falls_back_never_second_target():
+    dead = _FakeWireDecode(fail_ships=2)
+    other = _FakeWireDecode()
+    coord = DisaggCoordinator([dead, other], [_FakePrefill()])
+    outs = coord.run([_req("w1", n_prompt=4)])
+    assert len(outs) == 1 and outs[0].finish_reason == "length"
+    assert dead.ship_calls == ["w1:4", "w1:4"]
+    # A different target could decode the request twice: forbidden.
+    assert other.ship_calls == []
+    assert coord.stats.disagg_fallbacks == 1
+    # The fallback used normal admission (first ranked decode worker).
+    assert dead.submitted == ["w1"]
+
+
+# -------------------------------------- role-filtered discovery (jax-free)
+
+
+def _write_beacon(directory, rank, addr, role=None):
+    rec = {"rank": rank, "ts": time.time(), "step": 1,
+           "metrics_addr": addr}
+    if role is not None:
+        rec["role"] = role
+    with open(os.path.join(directory, f"rank-{rank}.json"), "w") as f:
+        json.dump(rec, f)
+
+
+def test_role_filtered_discovery_never_adopts_prefill(tmp_path):
+    """Satellite regression: a decode controller (gateway discovery,
+    graftpilot's heartbeat_discoverer) must never adopt a prefill
+    worker as a decode replica — and beacons predating role extras
+    must keep counting as decode."""
+    from k8s_distributed_deeplearning_tpu.serve.autoscale import (
+        heartbeat_discoverer)
+    from k8s_distributed_deeplearning_tpu.serve.transport import (
+        discover_replica_clients)
+    from k8s_distributed_deeplearning_tpu.telemetry.fleet import (
+        discover_endpoints)
+    hb = str(tmp_path)
+    _write_beacon(hb, 0, "127.0.0.1:7100", role="decode")
+    _write_beacon(hb, 1, "127.0.0.1:7101", role="prefill")
+    _write_beacon(hb, 2, "127.0.0.1:7102")          # legacy: no role extra
+
+    assert discover_endpoints(hb) == [
+        "127.0.0.1:7100", "127.0.0.1:7101", "127.0.0.1:7102"]
+    assert discover_endpoints(hb, role="decode") == [
+        "127.0.0.1:7100", "127.0.0.1:7102"]
+    assert discover_endpoints(hb, role="prefill") == ["127.0.0.1:7101"]
+
+    # Gateway-side client discovery defaults to decode-only.
+    eps = sorted(c.endpoint for c in discover_replica_clients(hb))
+    assert eps == ["http://127.0.0.1:7100", "http://127.0.0.1:7102"]
+    pre = [c.endpoint for c in discover_replica_clients(hb, role="prefill")]
+    assert pre == ["http://127.0.0.1:7101"]
+
+    # graftpilot's async-backend hook: same decode default.
+    found = sorted(c.endpoint for c in heartbeat_discoverer(hb)([]))
+    assert found == ["http://127.0.0.1:7100", "http://127.0.0.1:7102"]
+    found_pre = [c.endpoint
+                 for c in heartbeat_discoverer(hb, role="prefill")([])]
+    assert found_pre == ["http://127.0.0.1:7101"]
+
+
+# ------------------------------------------------- real-model integration
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    import jax
+    import jax.numpy as jnp
+    from k8s_distributed_deeplearning_tpu.models import llama
+    cfg = llama.config_tiny(dtype=jnp.float32, max_seq_len=96)
+    model = llama.LlamaLM(cfg)
+    params = model.init(jax.random.key(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    return cfg, model, params
+
+
+MAX_NEW = 16
+_PROMPT_LENS = (11, 23, 37, 70, 45, 33)     # last three: chunked-kill set
+
+
+@pytest.fixture(scope="module")
+def prompts(tiny):
+    cfg, _, _ = tiny
+    rng = np.random.default_rng(1)
+    return [[int(t) for t in rng.integers(3, cfg.vocab_size, size=n)]
+            for n in _PROMPT_LENS]
+
+
+@pytest.fixture(scope="module")
+def refs(tiny, prompts):
+    """Unified-engine oracle tokens, one batch-of-one run per prompt."""
+    from k8s_distributed_deeplearning_tpu.serve import ServeEngine
+    _, model, params = tiny
+    eng = ServeEngine(model, params, num_slots=2, eos_id=None)
+    out = {}
+    for i, p in enumerate(prompts):
+        (o,) = eng.run([Request(prompt=list(p), max_new_tokens=MAX_NEW,
+                                request_id=f"ref{i}")])
+        out[i] = o.tokens
+    c = eng.pool.counters()
+    assert c["pages_used"] == 0 and eng.pool.reserved == 0
+    return out
+
+
+def _mk(tiny, **kw):
+    from k8s_distributed_deeplearning_tpu.serve import ServeEngine
+    _, model, params = tiny
+    kw.setdefault("num_slots", 2)
+    return ServeEngine(model, params, eos_id=None, **kw)
+
+
+def _assert_clean(*engines):
+    for eng in engines:
+        c = eng.pool.counters()
+        assert c["pages_used"] == 0, (getattr(eng, "replica_id", None), c)
+        assert eng.pool.reserved == 0
+
+
+def _drive(coord, deadline_s=240.0):
+    outs = []
+    t0 = time.time()
+    while coord.busy():
+        outs.extend(coord.step())
+        assert time.time() - t0 < deadline_s, "coordinator did not quiesce"
+    return outs
+
+
+def test_engine_export_import_round_trip_parity(tiny, prompts, refs):
+    """Engine level: prefill-only export -> wire codec -> import under
+    the ``imported`` owner tag -> bit-identical decode, no leaks."""
+    src = _mk(tiny, prefill_only=True)
+    src.submit(Request(prompt=list(prompts[2]), max_new_tokens=MAX_NEW,
+                       request_id="rt0"))
+    blobs = []
+    while not blobs:
+        src.step()
+        blobs = src.take_exports()
+    (blob,) = blobs
+    # Export is by value: the prefill pool holds nothing once taken.
+    _assert_clean(src)
+    rt = decode_blob(json.loads(json.dumps(encode_blob(blob))))
+    assert "t_submit" not in rt
+    assert all(np.array_equal(a, b)
+               for a, b in zip(rt["pages"], blob["pages"]))
+
+    dst = _mk(tiny)
+    dst.import_request_kv(rt)
+    owners = dst.pool.owners_summary()
+    assert owners["imported"] == blob["n_pages"]
+    assert dst.stats.disagg_imports == 1
+    fin = []
+    while dst.busy():
+        fin.extend(dst.step())
+    assert fin[0].tokens == refs[2]
+    assert fin[0].finish_reason == "length"
+    _assert_clean(dst)
+    assert src.stats.disagg_exports == 1
+
+
+def test_in_process_coordinator_parity(tiny, prompts, refs):
+    log = _Events()
+    pre = PrefillWorker(_mk(tiny, prefill_only=True))
+    d1, d2 = _mk(tiny), _mk(tiny)
+    coord = DisaggCoordinator([d1, d2], [pre], logger=log)
+    outs = coord.run([Request(prompt=list(prompts[i]),
+                              max_new_tokens=MAX_NEW,
+                              request_id=f"c{i}") for i in range(3)])
+    assert len(outs) == 3
+    for o in outs:
+        i = int(o.request_id[1:])
+        assert o.tokens == refs[i], o.request_id
+        assert o.finish_reason == "length"
+    assert d1.stats.disagg_imports + d2.stats.disagg_imports == 3
+    assert pre.engine.stats.disagg_exports == 3
+    assert coord.stats.disagg_fallbacks == 0
+    assert log.names().count("disagg_shipped") == 3
+    _assert_clean(pre.engine, d1, d2)
+
+
+def test_empty_prefill_fleet_is_unified_path(tiny, prompts, refs):
+    dec = _mk(tiny)
+    coord = DisaggCoordinator([dec])
+    outs = coord.run([Request(prompt=list(prompts[0]),
+                              max_new_tokens=MAX_NEW, request_id="n0")])
+    assert outs[0].tokens == refs[0]
+    assert coord.stats.disagg_fallbacks == 1    # unified routing counted
+    assert dec.stats.disagg_imports == 0
+    _assert_clean(dec)
+
+
+def test_prefill_kill_mid_chunk_fallback_parity(tiny, prompts, refs):
+    """The headline chaos case: chunked prefill (32-token chunks), kill
+    the worker after one coordinator step — every prompt is mid-chunk —
+    and every request must complete bit-identically with zero lost."""
+    log = _Events()
+    pre = PrefillWorker(
+        _mk(tiny, prefill_only=True, num_slots=3, prefill_chunk_tokens=32),
+        worker_id="pw")
+    dec = _mk(tiny, num_slots=3)
+    coord = DisaggCoordinator([dec], [pre], logger=log)
+    for i in (3, 4, 5):
+        coord.submit(Request(prompt=list(prompts[i]),
+                             max_new_tokens=MAX_NEW, request_id=f"k{i}"))
+    coord.step()                      # partial chunks only (70/45/33 > 32)
+    assert pre.engine.stats.disagg_exports == 0, \
+        "prompts must still be mid-chunk when the worker dies"
+    coord.kill_prefill("pw")
+    outs = _drive(coord)
+    assert len(outs) == 3, "zero lost requests"
+    for o in outs:
+        i = int(o.request_id[1:])
+        assert o.tokens == refs[i], o.request_id
+        assert o.finish_reason == "length"
+    assert coord.stats.disagg_fallbacks == 3
+    assert "disagg_prefill_down" in log.names()
+    assert dec.stats.disagg_imports == 0
+    _assert_clean(dec)     # the killed worker's pool dies with its process
+
+
+def test_gateway_drain_migration_ships_pages(tiny, prompts, refs):
+    """Satellite: drain/scale-down migration rides the KV page shipping
+    path — the target ADOPTS the source's pages (one export, one
+    import) instead of re-prefilling, and the stream stays
+    bit-identical across the hop."""
+    from k8s_distributed_deeplearning_tpu.serve import ServeGateway
+    e0 = _mk(tiny, replica_id="r0")
+    e1 = _mk(tiny, replica_id="r1")
+    gw = ServeGateway([e0, e1])
+    got = []
+    gw.submit(Request(prompt=list(prompts[1]), max_new_tokens=MAX_NEW,
+                      request_id="g0", on_token=got.append))
+    for _ in range(8):
+        gw.step()
+    src = "r0" if e0.occupied_slots() else "r1"
+    gw.drain_replica(src)
+    outs = []
+    steps = 0
+    while gw.busy():
+        outs.extend(gw.step())
+        steps += 1
+        assert steps < 10_000
+    assert outs[0].tokens == refs[1]
+    assert got == refs[1]
+    assert e0.stats.disagg_imports + e1.stats.disagg_imports == 1
+    assert e0.stats.disagg_exports + e1.stats.disagg_exports == 1
+    _assert_clean(e0, e1)
+
+
+def _wire_pair(tiny, hb_dir):
+    """One prefill-role and one decode-role engine behind REAL replica
+    servers, with role beacons in *hb_dir*."""
+    from k8s_distributed_deeplearning_tpu.serve.transport import (
+        ReplicaClient, ReplicaServer)
+    pre_eng = _mk(tiny, prefill_only=True)
+    dec_eng = _mk(tiny)
+    pre_srv = ReplicaServer(pre_eng, role="prefill", heartbeat_dir=hb_dir,
+                            rank=0, handler_timeout=120.0).start()
+    dec_srv = ReplicaServer(dec_eng, role="decode", heartbeat_dir=hb_dir,
+                            rank=1, handler_timeout=120.0).start()
+    pre_cli = ReplicaClient(pre_srv.address, replica_id="pre",
+                            timeout_s=120.0, backoff_s=0.05,
+                            health_refresh_s=0.0)
+    dec_cli = ReplicaClient(dec_srv.address, replica_id="dec",
+                            timeout_s=120.0, backoff_s=0.05,
+                            health_refresh_s=0.0)
+    return pre_eng, dec_eng, pre_srv, dec_srv, pre_cli, dec_cli
+
+
+def test_wire_disagg_parity_and_role_discovery(tiny, prompts, refs,
+                                               tmp_path):
+    from k8s_distributed_deeplearning_tpu.serve.transport import (
+        discover_replica_clients)
+    from k8s_distributed_deeplearning_tpu.telemetry.fleet import (
+        discover_endpoints)
+    hb = str(tmp_path)
+    pre_eng, dec_eng, pre_srv, dec_srv, pre_cli, dec_cli = _wire_pair(
+        tiny, hb)
+    try:
+        coord = DisaggCoordinator([dec_cli],
+                                  [RemotePrefillWorker(pre_cli)])
+        got = []
+        coord.submit(Request(prompt=list(prompts[1]),
+                             max_new_tokens=MAX_NEW, request_id="wire0",
+                             on_token=got.append))
+        outs = _drive(coord)
+        assert outs[0].tokens == refs[1]
+        assert got == refs[1]
+        assert outs[0].finish_reason == "length"
+        assert pre_eng.stats.disagg_exports == 1
+        assert dec_eng.stats.disagg_imports == 1
+        assert coord.stats.disagg_fallbacks == 0
+
+        # Live role beacons: gateway/controller discovery stays decode-
+        # only; the prefill tier is its own filtered view.
+        assert discover_endpoints(hb, role="decode") == [dec_srv.address]
+        assert discover_endpoints(hb, role="prefill") == [pre_srv.address]
+        assert sorted(discover_endpoints(hb)) == sorted(
+            [dec_srv.address, pre_srv.address])
+        cls = discover_replica_clients(hb)
+        assert [c.endpoint for c in cls] == [f"http://{dec_srv.address}"]
+        _assert_clean(pre_eng, dec_eng)
+    finally:
+        pre_srv.close()
+        dec_srv.close()
+
+
+def test_wire_pages_ledger_answers_duplicate_exactly_once(tiny, prompts,
+                                                          refs, tmp_path):
+    """A re-sent transfer after an ambiguous failure gets the ORIGINAL
+    adoption result back — one import, one decode stream, no second
+    slot, no leaked pages."""
+    _, dec_eng, pre_srv, dec_srv, _, dec_cli = _wire_pair(
+        tiny, str(tmp_path))
+    src = _mk(tiny, prefill_only=True)
+    try:
+        src.submit(Request(prompt=list(prompts[2]),
+                           max_new_tokens=MAX_NEW, request_id="led0"))
+        blobs = []
+        while not blobs:
+            src.step()
+            blobs = src.take_exports()
+        (blob,) = blobs
+        key = transfer_key(blob)
+        # TTFT is a prefill-side event: the first token travels in the
+        # blob; the adopted stream carries only tokens decoded after it.
+        emitted = [int(t) for t in blob["emitted"]]
+        assert emitted == refs[2][:len(emitted)]
+
+        got, fin = [], []
+        req = request_from_blob(blob)
+        req.on_token = got.append
+        req.on_finish = fin.append
+        body1 = dec_cli.ship_pages(blob, req=req, transfer_key=key)
+        assert body1["adopted"] and not body1.get("duplicate")
+        # Same key again — the ledger answers, the engine does NOT
+        # import a second time.
+        body2 = dec_cli.ship_pages(blob, transfer_key=key)
+        assert body2.get("duplicate") is True
+        assert body2["slot"] == body1["slot"]
+        assert dec_eng.stats.disagg_imports == 1
+        assert dec_eng.stats.transport_dedup_hits == 1
+
+        t0 = time.time()
+        while not fin:
+            dec_cli.step()
+            assert time.time() - t0 < 240.0
+        assert emitted + got == refs[2]
+        _assert_clean(src, dec_eng)
+    finally:
+        pre_srv.close()
+        dec_srv.close()
+
+
+def test_wire_drop_fault_is_transparent(tiny, prompts, refs, tmp_path):
+    """transport_pages drop (count=1): the chunk vanishes on the wire,
+    the client's bounded retry re-sends, adoption happens exactly once
+    and the stream is bit-identical — no fallback needed."""
+    pre_eng, dec_eng, pre_srv, dec_srv, pre_cli, dec_cli = _wire_pair(
+        tiny, str(tmp_path))
+    try:
+        coord = DisaggCoordinator([dec_cli],
+                                  [RemotePrefillWorker(pre_cli)])
+        coord.submit(Request(prompt=list(prompts[0]),
+                             max_new_tokens=MAX_NEW, request_id="drop0"))
+        faults.activate(FaultPlan((
+            Fault(site="transport_pages", action="drop", count=1),)))
+        outs = _drive(coord)
+        inj = faults.active()
+        assert ("transport_pages", "drop") in inj.fired
+        assert outs[0].tokens == refs[0]
+        assert dec_eng.stats.disagg_imports == 1
+        assert coord.stats.disagg_fallbacks == 0
+        _assert_clean(pre_eng, dec_eng)
+    finally:
+        dec_srv.close()
+        pre_srv.close()
+
+
+def test_wire_partition_falls_back_without_double_adopt(tiny, prompts,
+                                                        refs, tmp_path):
+    """transport_pages partition window: every ship attempt (including
+    the coordinator's one same-target retry) fails, the request falls
+    back through normal decode admission — completed bit-identically,
+    adopted ZERO times, nothing leaked on either side."""
+    pre_eng, dec_eng, pre_srv, dec_srv, pre_cli, dec_cli = _wire_pair(
+        tiny, str(tmp_path))
+    try:
+        coord = DisaggCoordinator([dec_cli],
+                                  [RemotePrefillWorker(pre_cli)])
+        coord.submit(Request(prompt=list(prompts[0]),
+                             max_new_tokens=MAX_NEW, request_id="part0"))
+        faults.activate(FaultPlan((
+            Fault(site="transport_pages", action="partition",
+                  seconds=300.0),)))
+        outs = _drive(coord)
+        assert outs[0].tokens == refs[0]
+        assert outs[0].finish_reason == "length"
+        assert coord.stats.disagg_fallbacks == 1
+        assert dec_eng.stats.disagg_imports == 0, "no double adoption"
+        # The export left the prefill pool by value; the blob that could
+        # not ship holds host bytes only — both pools come back clean.
+        _assert_clean(pre_eng, dec_eng)
+    finally:
+        faults.deactivate()
+        dec_srv.close()
+        pre_srv.close()
