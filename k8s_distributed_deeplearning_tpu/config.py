@@ -194,6 +194,17 @@ class JobConfig:
     autoscale_up_cooldown_s: float | None = None
     autoscale_down_cooldown_s: float | None = None
     autoscale_brownout: str | None = None  # comma-separated stage names
+    # Chaos soak (serve/storm.py, graftstorm): when storm_steps is set
+    # the renderer emits a single-pod "serve-storm" Job running
+    # ``launch storm`` — seeded open-loop traffic + a seeded randomized
+    # fault schedule + the invariant monitor, in one process (the soak
+    # IS the fleet; it needs no probes or Services). storm_seed is the
+    # replay key printed in every violation's repro line;
+    # storm_fault_rate is the upper per-visit firing probability.
+    # validate.py enforces the domains offline.
+    storm_steps: int | None = None
+    storm_seed: int | None = None
+    storm_fault_rate: float | None = None
 
     def chips_per_worker(self) -> int:
         """TPU chips each pod must request: the slice's chip total (product of

@@ -23,6 +23,7 @@ Hook-site usage pattern (zero-cost when no plan)::
 from __future__ import annotations
 
 import os
+import random
 import signal
 import sys
 import time
@@ -44,6 +45,12 @@ class FaultInjector:
     call-count-triggered faults (transient IOErrors); step-triggered faults
     compare against the hook's ``step`` directly, so they are deterministic
     under restarts regardless of how many hook visits preceded them.
+
+    Probabilistic (``p``) faults draw from per-fault ``random.Random``
+    streams seeded by ``(plan.seed, fault index, rank)`` — independent
+    streams, so adding a fault to the plan never perturbs the draws of
+    the faults before it, and the same plan replays the identical firing
+    sequence on the same visit sequence (graftstorm's repro contract).
     """
 
     def __init__(self, plan: FaultPlan, *, rank: int = 0, attempt: int = 0,
@@ -56,6 +63,13 @@ class FaultInjector:
         self._sleep = sleep
         self._clock = clock
         self._visits = [0] * len(plan.faults)
+        self._fires = [0] * len(plan.faults)
+        # str seeds hash through SHA-512 in random.Random — stable across
+        # processes and platforms, unlike hash() of a tuple.
+        self._rngs = [
+            random.Random(f"{plan.seed}:{i}:{rank}") if f.p is not None
+            else None
+            for i, f in enumerate(plan.faults)]
         # Active partition windows: site -> monotonic deadline. A fired
         # "partition" fault severs its site for the fault's ``seconds`` —
         # EVERY subsequent fire at that site raises until the window
@@ -72,6 +86,18 @@ class FaultInjector:
         if f.step is not None:
             return step == f.step
         self._visits[i] += 1
+        if f.p is not None:
+            # Probabilistic per-visit trigger inside the after/count
+            # window: skip the first ``after`` visits, stop for good
+            # after ``count`` fires. The RNG is consumed ONLY on
+            # in-window visits, so the draw sequence is a pure function
+            # of the visit sequence.
+            if self._visits[i] <= f.after or self._fires[i] >= f.count:
+                return False
+            if self._rngs[i].random() >= f.p:
+                return False
+            self._fires[i] += 1
+            return True
         return f.after < self._visits[i] <= f.after + f.count
 
     def fire(self, site: str, *, step: int | None = None,
@@ -234,11 +260,15 @@ def active() -> FaultInjector | None:
 
 
 def activate(plan: FaultPlan, *, rank: int = 0, attempt: int = 0,
-             sleep: Callable[[float], None] = time.sleep) -> FaultInjector:
+             sleep: Callable[[float], None] = time.sleep,
+             clock: Callable[[], float] = time.monotonic) -> FaultInjector:
     """Install *plan* as the process's active injector (in-process tests;
-    worker processes use the env instead). Returns the injector."""
+    worker processes use the env instead). Returns the injector.
+    ``clock`` is injectable so partition windows run on a virtual clock
+    (graftstorm) instead of the wallclock."""
     global _injector, _resolved
-    _injector = FaultInjector(plan, rank=rank, attempt=attempt, sleep=sleep)
+    _injector = FaultInjector(plan, rank=rank, attempt=attempt, sleep=sleep,
+                              clock=clock)
     _resolved = True
     return _injector
 

@@ -76,6 +76,21 @@ Actions (what happens when the trigger matches):
                wall-clock at this site — a severed link stays severed
                until it heals, unlike the count-scoped ``ioerror`` blip.
                Transport sites only; needs ``seconds`` > 0
+
+Triggers come in three flavours, mutually exclusive per fault:
+
+- step-scoped: ``step`` equals the hook's step — exact and restart-proof
+- visit-scoped: skip ``after`` visits, then fire ``count`` times — the
+  transient-blip shape
+- probabilistic (graftstorm): ``p`` in (0, 1] fires each visit with that
+  probability, drawn from a per-fault ``random.Random`` stream seeded
+  from the PLAN-level ``seed`` + the fault's index + the rank — so the
+  same plan replays the identical firing sequence on the same visit
+  sequence, which is what makes a randomized chaos soak a repro line
+  instead of an anecdote. ``after``/``count`` still bound the window
+  (skip the first ``after`` visits; stop after ``count`` fires). ``p``
+  requires the plan to carry ``seed``; validation rejects the dangling
+  half.
 """
 from __future__ import annotations
 
@@ -117,7 +132,9 @@ class Fault:
     ``attempt``: which restart incarnation fires (0 = the first run only —
     the default, so a kill-fault doesn't re-kill the recovered job forever;
     None = every attempt). ``seconds`` feeds ``stall`` and the ``executor``
-    kill delay; ``exit_code`` feeds ``exit``.
+    kill delay; ``exit_code`` feeds ``exit``. ``p`` makes the trigger
+    probabilistic per visit (seeded by the plan's ``seed`` — see module
+    docstring); mutually exclusive with ``step``.
     """
 
     site: str
@@ -129,6 +146,7 @@ class Fault:
     seconds: float = 0.0
     exit_code: int = 43
     attempt: int | None = 0
+    p: float | None = None
 
     def problems(self) -> list[str]:
         errs = []
@@ -155,18 +173,37 @@ class Fault:
             errs.append(f"after must be >= 0, got {self.after}")
         if self.rank is not None and self.rank < 0:
             errs.append(f"rank must be >= 0, got {self.rank}")
+        if self.p is not None:
+            if not isinstance(self.p, (int, float)) \
+                    or isinstance(self.p, bool) \
+                    or not 0.0 < float(self.p) <= 1.0:
+                errs.append(f"p must be in (0, 1], got {self.p!r}")
+            if self.step is not None:
+                errs.append("p and step are mutually exclusive triggers "
+                            "(probabilistic-per-visit vs exact-step)")
+            if self.site == "executor":
+                errs.append("executor faults are delay-based (seconds), "
+                            "not probabilistic")
         return errs
 
 
 @dataclasses.dataclass(frozen=True)
 class FaultPlan:
-    """An ordered collection of faults, serializable to/from JSON."""
+    """An ordered collection of faults, serializable to/from JSON.
+
+    ``seed`` feeds the per-fault RNG streams of probabilistic (``p``)
+    triggers; a plan with no ``p`` faults does not need one (and omits
+    it from its JSON, keeping pre-storm plans byte-identical)."""
 
     faults: tuple[Fault, ...] = ()
+    seed: int | None = None
 
     def to_json(self) -> str:
-        return json.dumps({"faults": [dataclasses.asdict(f)
-                                      for f in self.faults]})
+        doc: dict = {"faults": [dataclasses.asdict(f)
+                                for f in self.faults]}
+        if self.seed is not None:
+            doc["seed"] = self.seed
+        return json.dumps(doc)
 
     @classmethod
     def from_json(cls, text: str) -> "FaultPlan":
@@ -177,8 +214,13 @@ class FaultPlan:
         if not isinstance(doc, dict) or not isinstance(doc.get("faults"),
                                                        list):
             raise ValueError(
-                'fault plan must be {"faults": [...]}, got '
+                'fault plan must be {"faults": [...], "seed"?: int}, got '
                 f"{type(doc).__name__}")
+        extra = set(doc) - {"faults", "seed"}
+        if extra:
+            raise ValueError(
+                f"fault plan has unknown top-level fields {sorted(extra)} "
+                '(known: ["faults", "seed"])')
         known = {f.name for f in dataclasses.fields(Fault)}
         faults = []
         for i, rec in enumerate(doc["faults"]):
@@ -193,15 +235,23 @@ class FaultPlan:
                 faults.append(Fault(**rec))
             except TypeError as e:
                 raise ValueError(f"faults[{i}]: {e}") from e
-        return cls(faults=tuple(faults))
+        return cls(faults=tuple(faults), seed=doc.get("seed"))
 
     def problems(self) -> list[str]:
         """Validation errors (empty = plan is well-formed). Used by
         ``launch/validate.py`` so a bad plan fails at render time, not
         half an hour into the chaos run."""
         errs: list[str] = []
+        if self.seed is not None and (not isinstance(self.seed, int)
+                                      or isinstance(self.seed, bool)):
+            errs.append(f"seed must be an int, got {self.seed!r}")
         for i, f in enumerate(self.faults):
             errs.extend(f"faults[{i}]: {p}" for p in f.problems())
+            if f.p is not None and self.seed is None:
+                errs.append(
+                    f"faults[{i}]: p={f.p} needs a plan-level seed — an "
+                    "unseeded probabilistic fault cannot replay, which "
+                    "defeats the repro-line contract")
         return errs
 
     def validate_or_raise(self) -> "FaultPlan":

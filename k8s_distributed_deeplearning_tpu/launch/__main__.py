@@ -9,6 +9,8 @@ Usage:
       -- --num-steps 40 --no-eval
   python -m k8s_distributed_deeplearning_tpu.launch serve \
       --preset tiny --requests 32 --slots 4
+  python -m k8s_distributed_deeplearning_tpu.launch storm \
+      --seed 0 --steps 200 --replicas 2 --autoscale
 
 ``validate`` runs the offline structural checks and, when kubectl can reach
 a cluster, a server-side dry-run. ``run-local`` executes the rendered pod
@@ -35,6 +37,11 @@ def main(argv: list[str] | None = None) -> int:
         # importing jax eagerly here would slow every render/validate call.
         from k8s_distributed_deeplearning_tpu.serve import cli as serve_cli
         return serve_cli.main(argv[1:])
+    if argv and argv[0] == "storm":
+        # Same deal for the chaos soak: its own flag surface, and the
+        # heavy model imports stay behind its argument validation.
+        from k8s_distributed_deeplearning_tpu.serve import storm as storm_cli
+        return storm_cli.main(argv[1:])
     script_args: list[str] = []
     if "--" in argv:
         i = argv.index("--")
@@ -101,6 +108,20 @@ def main(argv: list[str] | None = None) -> int:
             help="decode slots per serving replica (default: the serve "
                  "CLI's own default)")
         p.add_argument(
+            "--storm-steps", type=int, default=d.storm_steps,
+            help="also render the graftstorm chaos-soak Job "
+                 "(serve/storm.py): one pod running `launch storm` for "
+                 "this many harness steps — seeded traffic + seeded "
+                 "faults + the invariant monitor, exit 1 on violation")
+        p.add_argument(
+            "--storm-seed", type=int, default=d.storm_seed,
+            help="the soak's replay key (printed in every violation's "
+                 "repro line); default 0")
+        p.add_argument(
+            "--storm-fault-rate", type=float, default=d.storm_fault_rate,
+            help="upper per-visit firing probability for the soak's "
+                 "scheduled faults (0 < rate <= 1)")
+        p.add_argument(
             "--serve-tp", type=int, default=d.serve_tp,
             help="tensor-parallel width per serving replica (graftmesh): "
                  "each replica pod requests this many TPU chips and runs "
@@ -156,7 +177,10 @@ def main(argv: list[str] | None = None) -> int:
                     serve_prefill_replicas=args.serve_prefill_replicas,
                     serve_preset=args.serve_preset,
                     serve_slots=args.serve_slots,
-                    serve_tp=args.serve_tp)
+                    serve_tp=args.serve_tp,
+                    storm_steps=args.storm_steps,
+                    storm_seed=args.storm_seed,
+                    storm_fault_rate=args.storm_fault_rate)
     docs = render.render_all(cfg)
     text = render.to_yaml(docs)
 

@@ -257,9 +257,10 @@ def _serving_pod(cfg: JobConfig, *, role: str, container: dict,
             "containers": [container],
         },
     }
-    if role in ("serve-replica", "serve-prefill"):
-        # Both engine-carrying tiers run on TPU; only the gateway/
-        # coordinator pod is pure CPU dispatch.
+    if role in ("serve-replica", "serve-prefill", "serve-storm"):
+        # Engine-carrying tiers run on TPU; only the gateway/
+        # coordinator pod is pure CPU dispatch. The storm pod carries
+        # its whole in-process fleet, so it claims chips too.
         tmpl["spec"]["nodeSelector"] = {
             "cloud.google.com/gke-tpu-accelerator": cfg.tpu_accelerator,
             "cloud.google.com/gke-tpu-topology": cfg.tpu_topology,
@@ -465,6 +466,58 @@ def render_gateway_job(cfg: JobConfig) -> dict:
                         container=container, subdomain=name)
 
 
+def render_storm_job(cfg: JobConfig) -> dict:
+    """Chaos-soak role (serve/storm.py, graftstorm): ONE pod that runs
+    the whole exercise — seeded traffic, seeded fault schedule, the
+    in-process replica fleet and the invariant monitor. Determinism is
+    the point, so nothing is distributed: no Services, no probes (the
+    soak is a batch Job that exits 0 clean / 1 on violation), just the
+    TPU claim for the engines it hosts and the metrics port for watching
+    a long soak live."""
+    name = f"{cfg.name}-storm"
+    command = ["python", "-m", "k8s_distributed_deeplearning_tpu.launch",
+               "storm",
+               "--seed", str(cfg.storm_seed or 0),
+               "--steps", str(cfg.storm_steps),
+               "--replicas", str(cfg.serve_replicas or 2),
+               "--preset", cfg.serve_preset,
+               "--metrics-port", str(cfg.metrics_port)]
+    if cfg.serve_slots is not None:
+        command += ["--slots", str(cfg.serve_slots)]
+    if cfg.storm_fault_rate is not None:
+        lo = min(0.05, float(cfg.storm_fault_rate))
+        command += ["--fault-rate", str(lo), str(cfg.storm_fault_rate)]
+    if cfg.autoscale_max is not None:
+        command += ["--autoscale", "--autoscale-max",
+                    str(cfg.autoscale_max)]
+    if cfg.serve_prefill_replicas:
+        command += ["--prefill", str(cfg.serve_prefill_replicas)]
+    if cfg.flight_ring is not None:
+        command += ["--flight-ring", str(cfg.flight_ring)]
+    if cfg.flight_dir is not None:
+        command += ["--flight-dir", cfg.flight_dir]
+    container = {
+        "name": "storm",
+        "image": cfg.image,
+        "command": command,
+        "env": _serving_env(cfg),
+        "ports": [{"containerPort": cfg.metrics_port, "name": "metrics"}],
+        "resources": {
+            "requests": {"cpu": cfg.cpu, "memory": cfg.memory},
+            "limits": {"cpu": cfg.cpu, "memory": cfg.memory,
+                       "google.com/tpu": str(_serving_chips(cfg))},
+        },
+    }
+    job = _serving_job(cfg, name=name, role="serve-storm", replicas=1,
+                       container=container, subdomain=name)
+    # A soak is one deterministic attempt: a retried soak with the same
+    # seed would just replay the same violation, so fail fast instead of
+    # burning backoffLimit laps on it.
+    job["spec"]["backoffLimit"] = 0
+    job["spec"]["template"]["spec"]["restartPolicy"] = "Never"
+    return job
+
+
 def render_serving(cfg: JobConfig) -> list[dict]:
     """The remote-serving tier: replica headless Service + replica-server
     Indexed Job + gateway Job, plus — when ``cfg.serve_prefill_replicas``
@@ -482,6 +535,8 @@ def render_all(cfg: JobConfig) -> list[dict]:
     docs = [render_namespace(cfg), render_service(cfg), render_tpujob(cfg)]
     if cfg.serve_replicas:
         docs.extend(render_serving(cfg))
+    if cfg.storm_steps:
+        docs.append(render_storm_job(cfg))
     return docs
 
 

@@ -610,6 +610,58 @@ def _check_serving_job(errors, where: str, job: dict,
                               role="serve-prefill", tier="prefill")
 
 
+def _check_storm_job(errors, where: str, job: dict) -> None:
+    """The chaos-soak Job (serve/storm.py): no gang, no Services, no
+    probes — its contract is flag-domain sanity (a soak with steps=0 or
+    p>1 dies at argparse INSIDE the pod, which is the expensive place to
+    find out) plus one-attempt retry semantics (a same-seed retry would
+    deterministically replay the same violation)."""
+    spec = job.get("spec", {})
+    tmpl = spec.get("template", {}).get("spec", {})
+    containers = tmpl.get("containers") or []
+    cmd = [str(x) for x in (containers[0].get("command") or [])] \
+        if containers else []
+    if "storm" not in cmd:
+        _err(errors, where, "serve-storm Job must run `launch storm`")
+        return
+
+    def _flag(name):
+        try:
+            return cmd[cmd.index(name) + 1]
+        except (ValueError, IndexError):
+            return None
+
+    steps = _flag("--steps")
+    if steps is None or not steps.lstrip("-").isdigit() or int(steps) < 1:
+        _err(errors, where, f"--steps must be an int >= 1, got {steps!r}")
+    seed = _flag("--seed")
+    if seed is not None and (not seed.lstrip("-").isdigit()
+                             or int(seed) < 0):
+        _err(errors, where, f"--seed must be an int >= 0, got {seed!r} "
+             "(the seed is the replay key in every repro line)")
+    reps = _flag("--replicas")
+    if reps is not None and (not reps.lstrip("-").isdigit()
+                             or int(reps) < 1):
+        _err(errors, where, f"--replicas must be an int >= 1, got {reps!r}")
+    if "--fault-rate" in cmd:
+        i = cmd.index("--fault-rate")
+        pair = cmd[i + 1:i + 3]
+        try:
+            lo, hi = (float(x) for x in pair)
+            ok = 0.0 < lo <= hi <= 1.0
+        except (TypeError, ValueError):
+            ok = False
+        if not ok:
+            _err(errors, where, f"--fault-rate needs 0 < LO <= HI <= 1, "
+                 f"got {pair!r}")
+    if spec.get("backoffLimit") != 0:
+        _err(errors, where, "storm Job must have backoffLimit 0 — a "
+             "same-seed retry deterministically replays the same failure")
+    if tmpl.get("restartPolicy") != "Never":
+        _err(errors, where, "storm pods need restartPolicy Never "
+             "(one deterministic attempt)")
+
+
 def _check_tier_endpoints(errors, where: str, eps: list[str],
                           by_kind: dict[str, list[dict]], *, role: str,
                           tier: str) -> None:
@@ -692,7 +744,13 @@ def validate(docs: list[dict]) -> list[str]:
             _check_container(errors, where, c)
         _check_termination(errors, where, tmpl, containers)
 
-        if (job["metadata"].get("labels") or {}).get("role") in _SERVING_ROLES:
+        role = (job["metadata"].get("labels") or {}).get("role")
+        if role == "serve-storm":
+            # The soak is a one-pod batch exercise: no gang, no probe
+            # contract — just its own flag-domain + retry-policy checks.
+            _check_storm_job(errors, where, job)
+            continue
+        if role in _SERVING_ROLES:
             # Serving roles have no jax.distributed gang — their contract
             # is the probe split + gateway↔replica endpoint agreement.
             _check_serving_job(errors, where, job, by_kind)

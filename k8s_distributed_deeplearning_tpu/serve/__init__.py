@@ -25,6 +25,8 @@ from k8s_distributed_deeplearning_tpu.serve.request import (
 from k8s_distributed_deeplearning_tpu.serve.sched import (
     DEFAULT_TENANT, TenantConfig, TenantScheduler, load_tenants)
 from k8s_distributed_deeplearning_tpu.serve.scheduler import RequestQueue
+from k8s_distributed_deeplearning_tpu.serve.storm import (
+    InvariantMonitor, StormConfig, StormReport, run_storm)
 from k8s_distributed_deeplearning_tpu.serve.transport import (
     ReplicaClient, ReplicaServer, discover_replica_clients)
 
@@ -36,4 +38,5 @@ __all__ = ["ServeEngine", "ServeGateway", "Request", "RequestOutput",
            "DisaggCoordinator", "PrefillWorker", "RemotePrefillWorker",
            "FleetController", "BrownoutStage", "BROWNOUT_STAGE_NAMES",
            "default_brownout_stages", "EngineFactoryBackend",
-           "LocalProcessBackend", "K8sParallelismBackend"]
+           "LocalProcessBackend", "K8sParallelismBackend",
+           "StormConfig", "StormReport", "InvariantMonitor", "run_storm"]

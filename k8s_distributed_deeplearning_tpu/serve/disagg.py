@@ -55,6 +55,7 @@ from typing import Callable
 
 import numpy as np
 
+from k8s_distributed_deeplearning_tpu.faults import inject as _faults
 from k8s_distributed_deeplearning_tpu.serve.request import (
     EngineDraining, QueueFull, Request, RequestOutput, SamplingParams)
 from k8s_distributed_deeplearning_tpu.utils.metrics import (
@@ -402,10 +403,25 @@ class DisaggCoordinator:
         rid = str(blob["request_id"])
         e = self._entries.get(rid)
         req = e.req if e is not None else None
+        inj = _faults.active()
         for d in self._rank_decode():
             if hasattr(d, "import_request_kv"):
                 if not d.can_import(blob):
                     continue
+                if inj is not None:
+                    # The in-process analog of the wire path's /pages hop
+                    # (ReplicaClient._call fires this site per chunk): the
+                    # chaos soak severs KV shipping here too. A lost chunk
+                    # costs only the shipping win — the blob is host
+                    # memory, so the unified fallback re-prefills and the
+                    # client stream splices bit-identically (the
+                    # availability contract). Wire targets fire inside
+                    # the client instead, so no double count there.
+                    try:
+                        inj.fire("transport_pages")
+                    except OSError:
+                        self._fallback(e, why="pages_transport_fault")
+                        return
                 try:
                     d.import_request_kv(blob, request=req)
                 except (EngineDraining, ValueError, RuntimeError):

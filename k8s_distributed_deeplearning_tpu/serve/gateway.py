@@ -177,6 +177,7 @@ class ServeGateway:
                  stall_trip_s: float | None = None,
                  hedge_after_s: float | None = None,
                  max_hedges: int = 1,
+                 max_migrations: int | None = 8,
                  stats: ServingStats | None = None,
                  logger: MetricsLogger | None = None,
                  clock: Callable[[], float] = time.perf_counter,
@@ -194,6 +195,10 @@ class ServeGateway:
             raise ValueError(
                 f"hedge_after_s must be > 0 (None = off), got "
                 f"{hedge_after_s}")
+        if max_migrations is not None and max_migrations < 1:
+            raise ValueError(
+                f"max_migrations must be >= 1 (None = unbounded), got "
+                f"{max_migrations}")
         self.policy = policy if policy is not None else HealthPolicy()
         self.failures_to_trip = failures_to_trip
         self.probe_backoff_s = probe_backoff_s
@@ -201,6 +206,12 @@ class ServeGateway:
         self.stall_trip_s = stall_trip_s
         self.hedge_after_s = hedge_after_s
         self.max_hedges = max_hedges
+        # Poison-request quarantine: a request whose replica keeps dying
+        # under it gets this many migrations, then a terminal "poisoned"
+        # — otherwise one pathological prompt (a decode-crasher) would
+        # migration-loop the whole fleet forever. None = unbounded (the
+        # pre-quarantine behaviour, for tests that count migrations).
+        self.max_migrations = max_migrations
         self.stats = stats if stats is not None else ServingStats()
         self.logger = logger
         # Flight recorder (telemetry/flight.py): the gateway records the
@@ -727,6 +738,9 @@ class ServeGateway:
         client stream splices bit-identically on both paths)."""
         if g.finished or any(sh.alive for sh in g.shadows.values()):
             return False
+        if (self.max_migrations is not None
+                and g.migrations >= self.max_migrations):
+            return False       # quarantine: _migrate poisons, not ships
         src = h.engine
         if not hasattr(src, "export_request_kv"):
             return False        # remote replica: crash-path resume only
@@ -775,6 +789,21 @@ class ServeGateway:
             return
         if any(sh.alive for sh in g.shadows.values()):
             return       # hedge peer still carries this request
+        if (self.max_migrations is not None
+                and g.migrations >= self.max_migrations):
+            # Poison quarantine: this request has already burned its
+            # migration budget — the replicas it lands on keep dying
+            # under it. Terminal "poisoned" (exactly once, same latch as
+            # every other reason) instead of another lap of the fleet.
+            self.stats.record_gateway_poisoned()
+            if self.logger is not None:
+                self.logger.emit("gateway_poisoned",
+                                 request_id=g.req.request_id,
+                                 migrations=g.migrations,
+                                 from_replica=from_rid,
+                                 tokens_emitted=len(g.emitted))
+            self._finish_client(g, "poisoned")
+            return
         exclude = {from_rid}
         while True:
             target = self._route(exclude)

@@ -157,6 +157,10 @@ def serving_collector(registry: MetricsRegistry,
         "serve_gateway_breaker_trips_total": registry.gauge(
             "serve_gateway_breaker_trips_total",
             "per-replica circuit breaker open transitions"),
+        "serve_gateway_poisoned_total": registry.gauge(
+            "serve_gateway_poisoned_total",
+            "requests quarantined after exhausting the gateway's "
+            "max_migrations budget (terminal reason 'poisoned')"),
         "serve_transport_retries_total": registry.gauge(
             "serve_transport_retries_total",
             "remote-replica transport calls retried after a transient "
@@ -242,6 +246,7 @@ def serving_collector(registry: MetricsRegistry,
                "gateway_migrations": "serve_gateway_migrations_total",
                "gateway_hedges": "serve_gateway_hedges_total",
                "gateway_breaker_trips": "serve_gateway_breaker_trips_total",
+               "gateway_poisoned": "serve_gateway_poisoned_total",
                "disagg_exports": "serve_disagg_exports_total",
                "disagg_imports": "serve_disagg_imports_total",
                "disagg_bytes_shipped": "serve_disagg_bytes_shipped_total",
@@ -268,6 +273,40 @@ def serving_collector(registry: MetricsRegistry,
             spec_hist.labels(accepted=str(accepted)).set(float(count))
         for owner, count in summ.get("kv_pages_by_owner", {}).items():
             pages_by_owner.labels(owner=str(owner)).set(float(count))
+
+    registry.register_collector(collect)
+
+
+def storm_collector(registry: MetricsRegistry, monitor,
+                    injector=None) -> None:
+    """Register a pull-time collector over a graftstorm
+    :class:`serve.storm.InvariantMonitor`: the dashboard's soak panel
+    watches violations (which must stay at zero) and the open-loop
+    requests-in-flight level, plus submission and fault-firing totals so
+    a flatlined soak is distinguishable from a healthy quiet one. Same
+    zero-push discipline as :func:`serving_collector`."""
+    g_viol = registry.gauge(
+        "serve_storm_invariant_violations_total",
+        "invariant violations detected by the chaos-soak monitor "
+        "(conservation / leaks / parity / coherence) — any nonzero "
+        "value is a bug, not an operating condition")
+    g_flight = registry.gauge(
+        "serve_storm_requests_in_flight",
+        "storm requests submitted but not yet terminal (open-loop "
+        "backlog under chaos)")
+    g_sub = registry.gauge(
+        "serve_storm_requests_submitted_total",
+        "requests the storm traffic generator has submitted so far")
+    g_fired = registry.gauge(
+        "serve_storm_faults_fired_total",
+        "fault injections executed by the storm schedule so far")
+
+    def collect() -> None:
+        g_viol.set(float(len(monitor.violations)))
+        g_flight.set(float(monitor.in_flight()))
+        g_sub.set(float(monitor.submitted_total()))
+        g_fired.set(float(len(injector.fired) if injector is not None
+                          else 0))
 
     registry.register_collector(collect)
 

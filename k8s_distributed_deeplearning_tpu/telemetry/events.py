@@ -60,6 +60,9 @@ EVENTS: dict[str, str] = {
                             "stops until the half-open probe",
     "gateway_breaker_closed": "a half-open probe succeeded: the replica "
                               "is back in the routing set",
+    "gateway_poisoned": "a request exhausted the gateway's max_migrations "
+                        "budget (its replicas keep dying under it) and "
+                        "was quarantined with terminal reason 'poisoned'",
     "replica_drained": "a draining replica finished or migrated all of "
                        "its work (safe to terminate)",
     "spec_summary": "end-of-run speculative-decoding aggregate: draft "
@@ -116,6 +119,14 @@ EVENTS: dict[str, str] = {
     "disagg_prefill_down": "a prefill worker died or stopped answering; "
                            "its in-flight requests are being re-routed "
                            "through normal decode-side admission",
+    "storm_invariant_violation": "the chaos-soak monitor caught a "
+                                 "system-wide invariant break (lost/"
+                                 "duplicated request, leaked KV page, "
+                                 "parity or counter divergence) — kind, "
+                                 "detail and the seed repro line attached",
+    "storm_summary": "end-of-soak graftstorm aggregate: requests "
+                     "submitted/finished by reason, fault firings by "
+                     "site, peak fleet load, violation count, repro line",
 }
 
 _SNAKE = re.compile(r"^[a-z][a-z0-9_]*$")

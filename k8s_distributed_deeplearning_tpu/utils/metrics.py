@@ -138,6 +138,7 @@ class ServingStats:
         self.gateway_migrations = 0
         self.gateway_hedges = 0
         self.gateway_breaker_trips = 0
+        self.gateway_poisoned = 0
         # Remote-replica transport (serve/transport.py): transient-call
         # retries, idempotent submits the replica server deduplicated
         # (the ambiguous-failure path working as designed), and token
@@ -265,6 +266,13 @@ class ServingStats:
         self._tick()
         self.gateway_breaker_trips += 1
 
+    def record_gateway_poisoned(self) -> None:
+        """One request quarantined: it exhausted the gateway's
+        ``max_migrations`` budget (its replicas keep dying under it) and
+        was finished terminally with reason "poisoned"."""
+        self._tick()
+        self.gateway_poisoned += 1
+
     def record_transport_retry(self) -> None:
         """One remote-replica transport call retried after a transient
         failure (connection error / timeout / injected network fault)."""
@@ -364,6 +372,7 @@ class ServingStats:
             "gateway_migrations": self.gateway_migrations,
             "gateway_hedges": self.gateway_hedges,
             "gateway_breaker_trips": self.gateway_breaker_trips,
+            "gateway_poisoned": self.gateway_poisoned,
             "transport_retries": self.transport_retries,
             "transport_dedup_hits": self.transport_dedup_hits,
             "transport_reconnects": self.transport_reconnects,

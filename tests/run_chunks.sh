@@ -83,13 +83,18 @@ CHUNKS[autoscale]="tests/test_autoscale.py"
 # compiles prefill+decode engines (some behind ReplicaServer threads) —
 # its own chunk so transport/gateway stay under their timeouts.
 CHUNKS[disagg]="tests/test_disagg.py"
+# graftstorm (serve/storm.py chaos soak): seeded-replay and invariant-
+# monitor tests run on scripted jax-free engines, plus one real-engine
+# disagg soak that compiles its own tiny model — its own chunk so
+# gateway/disagg stay under their timeouts.
+CHUNKS[storm]="tests/test_storm.py"
 # graftmesh (tensor-parallel serving): the tp=2 parity matrix compiles
 # every engine program three times (tp 0/1/2) under shard_map — its own
 # chunk so serve/spec stay under their timeouts.
 CHUNKS[tp]="tests/test_tp_serve.py"
 CHUNKS[slow1]="tests/test_train_e2e.py tests/test_multiprocess.py"
 CHUNKS[slow2]="tests/test_multihost_train.py tests/test_multihost_llama.py tests/test_train_zoo.py"
-ORDER=(lint core parallel1 parallel2 moe train llama deploy serve sched paged faults graftscope fleet gateway spec flight transport autoscale disagg tp slow1 slow2)
+ORDER=(lint core parallel1 parallel2 moe train llama deploy serve sched paged faults graftscope fleet gateway spec flight transport autoscale disagg storm tp slow1 slow2)
 
 # --- completeness check: every tests/test_*.py in EXACTLY one chunk ------
 # ...and every declared chunk actually in ORDER: a chunk missing from the
