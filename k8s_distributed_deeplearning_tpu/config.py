@@ -205,6 +205,15 @@ class JobConfig:
     storm_steps: int | None = None
     storm_seed: int | None = None
     storm_fault_rate: float | None = None
+    # Quantized serving (graftquant): kv_quant="int8" carries
+    # $TPUJOB_KV_QUANT into every serving role (serve/cli.py --kv-quant:
+    # int8 KV pool pages with fused dequant-on-read) and
+    # weight_quant="int8" carries $TPUJOB_WEIGHT_QUANT (per-channel int8
+    # serving weights, dequantized at use). validate.py checks the mode
+    # names, that the quantized per-shard pool fits the pod memory
+    # limit, and the quant x tp divisibility offline.
+    kv_quant: str | None = None
+    weight_quant: str | None = None
 
     def chips_per_worker(self) -> int:
         """TPU chips each pod must request: the slice's chip total (product of

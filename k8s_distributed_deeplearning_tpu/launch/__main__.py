@@ -128,6 +128,16 @@ def main(argv: list[str] | None = None) -> int:
                  "its decode programs under shard_map; validate checks "
                  "head/MLP divisibility and per-shard pool fit offline "
                  "(0 = single-device, no mesh)")
+        p.add_argument(
+            "--kv-quant", default=d.kv_quant, choices=["int8"],
+            help="quantize the serving replicas' paged KV pool "
+                 "(graftquant): rendered as TPUJOB_KV_QUANT + --kv-quant "
+                 "on every serve-tier pod; validate sizes the pool with "
+                 "int8 pages + f32 scales instead of the fp estimate")
+        p.add_argument(
+            "--weight-quant", default=d.weight_quant, choices=["int8"],
+            help="per-channel int8 serving weights on the replica pods "
+                 "(rendered as TPUJOB_WEIGHT_QUANT + --weight-quant)")
     parsers["render"].add_argument(
         "--apply", action="store_true",
         help="pipe the manifests into kubectl apply -f -")
@@ -178,6 +188,8 @@ def main(argv: list[str] | None = None) -> int:
                     serve_preset=args.serve_preset,
                     serve_slots=args.serve_slots,
                     serve_tp=args.serve_tp,
+                    kv_quant=args.kv_quant,
+                    weight_quant=args.weight_quant,
                     storm_steps=args.storm_steps,
                     storm_seed=args.storm_seed,
                     storm_fault_rate=args.storm_fault_rate)

@@ -215,6 +215,17 @@ def _serving_env(cfg: JobConfig) -> list[dict]:
         env.append({"name": "TPUJOB_TENANTS", "value": cfg.tenants})
     if cfg.serve_tp is not None:
         env.append({"name": "TPUJOB_SERVE_TP", "value": str(cfg.serve_tp)})
+    # Quantized serving (graftquant, serve/cli.py --kv-quant/
+    # --weight-quant): every serving role carries the same modes — disagg
+    # roles MUST agree on kv_quant (pages ship as raw arena values and
+    # the importer adopts them bit-identically), and a mixed fleet would
+    # serve different numerics per replica. validate.py checks the mode
+    # names and the quantized pool-byte fit offline.
+    if cfg.kv_quant is not None:
+        env.append({"name": "TPUJOB_KV_QUANT", "value": cfg.kv_quant})
+    if cfg.weight_quant is not None:
+        env.append({"name": "TPUJOB_WEIGHT_QUANT",
+                    "value": cfg.weight_quant})
     # Elastic serving (serve/autoscale.py): each knob renders
     # independently so a dangling half (min without max, an unknown
     # brownout stage) is VISIBLE in the manifest — validate.py flags it

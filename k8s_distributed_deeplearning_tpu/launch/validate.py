@@ -74,6 +74,7 @@ def _check_container(errors, where: str, c: dict) -> None:
     _check_fleet_endpoints(errors, where, c)
     _check_spec(errors, where, c)
     _check_tp(errors, where, c)
+    _check_quant(errors, where, c)
     _check_flight(errors, where, c)
     _check_autoscale(errors, where, c)
 
@@ -291,7 +292,8 @@ def _check_tp(errors, where: str, c: dict) -> None:
                  f"preset {preset!r} (n_heads={heads}, num_kv_heads={kv}) "
                  f"is not divisible by TPUJOB_SERVE_TP ({tp}) — every "
                  "shard must own whole attention/KV heads")
-        else:
+        elif env.get("TPUJOB_KV_QUANT") is None:
+            # _check_quant owns the int8 byte math when kv_quant is set.
             slots = _int_flag(cmd, "--slots", 8)
             max_seq = _int_flag(cmd, "--max-seq-len", 512)
             pool = _int_flag(cmd, "--kv-pool-pages", 0)
@@ -319,6 +321,71 @@ def _check_tp(errors, where: str, c: dict) -> None:
                  f"num_kv_heads={dgeom[1]}) is not divisible by "
                  f"TPUJOB_SERVE_TP ({tp}) — the draft model shards over "
                  "the same tp mesh")
+
+
+_QUANT_MODES = ("int8",)
+
+
+def _check_quant(errors, where: str, c: dict) -> None:
+    """A manifest carrying graftquant env must be launchable offline:
+    mode names the engine knows (a typo'd mode dies in the ServeEngine
+    ctor after a TPU slice was scheduled); under $TPUJOB_KV_QUANT the
+    pool-byte fit is checked with the QUANTIZED page cost (int8 lanes
+    plus one f32 scale per KV head per token — the fp estimates in
+    _check_pool_bytes/_check_tp over-state a quantized pool, so this is
+    the bound that reflects what the pod actually allocates); and with
+    tp the scale leaves' kv-head lane dim must split evenly over the
+    mesh, the same divisibility the cache sharding asserts at boot."""
+    env = {e.get("name"): e for e in c.get("env", [])}
+    kvq = env.get("TPUJOB_KV_QUANT")
+    wq = env.get("TPUJOB_WEIGHT_QUANT")
+    if kvq is None and wq is None:
+        return
+    for label, e in (("TPUJOB_KV_QUANT", kvq),
+                     ("TPUJOB_WEIGHT_QUANT", wq)):
+        if e is None:
+            continue
+        raw = (e.get("value") or "").strip()
+        if raw not in _QUANT_MODES:
+            _err(errors, where,
+                 f"{label} {raw!r} is not a known quant mode "
+                 f"(have {list(_QUANT_MODES)}) — the ServeEngine ctor "
+                 "rejects it at boot")
+    if kvq is None or (kvq.get("value") or "").strip() != "int8":
+        return
+    tp_raw = ((env.get("TPUJOB_SERVE_TP") or {}).get("value") or "").strip()
+    tp = int(tp_raw) if tp_raw.isdigit() and int(tp_raw) >= 1 else 1
+    cmd = " ".join(str(x) for x in
+                   (c.get("command") or []) + (c.get("args") or []))
+    m = re.search(r"--preset\s+(\S+)", cmd)
+    geom = _SERVE_PRESET_GEOM.get(m.group(1) if m else "tiny")
+    if geom is None:
+        return
+    heads, kv, head_dim, layers, _itemsize = geom
+    if kv % tp:
+        _err(errors, where,
+             f"TPUJOB_KV_QUANT with TPUJOB_SERVE_TP ({tp}): preset "
+             f"num_kv_heads ({kv}) is not divisible by tp — the scale "
+             "leaves shard their per-KV-head lane dim over the mesh")
+        return
+    slots = _int_flag(cmd, "--slots", 8)
+    max_seq = _int_flag(cmd, "--max-seq-len", 512)
+    pool = _int_flag(cmd, "--kv-pool-pages", 0)
+    page_tokens = 32                # engine default: min_bucket
+    blocks = -(-max_seq // page_tokens)
+    pages = (pool if pool > 0 else slots * blocks) + 1
+    # int8 lane byte + 4-byte f32 scale per kv head per token, per shard.
+    per_shard = (pages * page_tokens * (kv // tp)
+                 * (head_dim + 4) * 2 * layers)
+    mem = _qty_bytes((c.get("resources", {}).get("limits") or {})
+                     .get("memory", ""))
+    if mem is not None and per_shard > mem:
+        _err(errors, where,
+             f"quantized per-shard KV pool (~{per_shard / 2 ** 20:.0f} "
+             f"MiB at tp={tp}) exceeds the container memory limit "
+             f"({mem / 2 ** 20:.0f} MiB) — int8 already shrank it; "
+             "shrink the pool (--kv-pool-pages / --slots / "
+             "--max-seq-len) or raise the limit")
 
 
 def _check_flight(errors, where: str, c: dict) -> None:
@@ -521,6 +588,8 @@ def _check_pool_bytes(errors, where: str, c: dict) -> None:
     env = {e.get("name"): e for e in c.get("env", [])}
     if env.get("TPUJOB_SERVE_TP") is not None:
         return
+    if env.get("TPUJOB_KV_QUANT") is not None:
+        return                      # _check_quant owns the int8 byte math
     cmd = " ".join(str(x) for x in
                    (c.get("command") or []) + (c.get("args") or []))
     m = re.search(r"--preset\s+(\S+)", cmd)

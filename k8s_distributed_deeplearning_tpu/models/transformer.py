@@ -123,11 +123,28 @@ class TransformerConfig:
                                         # tables (parallel/sharding.py) and
                                         # lets XLA place the collectives.
 
+    kv_quant: str | None = None         # "int8" quantizes the PAGED KV pool
+                                        # (graftquant): pool arenas store
+                                        # int8 rows plus per-token-per-head
+                                        # absmax scales in sibling
+                                        # cached_{key,value}_scale leaves
+                                        # [num_pages, page_tokens, kv];
+                                        # quantize-on-write at the paged
+                                        # scatter, dequant-on-read in both
+                                        # the XLA gather path and the Pallas
+                                        # kernel. None = fp pool; the dense
+                                        # (non-paged) cache paths are always
+                                        # fp — quantization is a pool-
+                                        # residency lever, not a compute one.
+
     def __post_init__(self):
         if self.remat_policy not in REMAT_POLICIES:
             raise ValueError(
                 f"remat_policy must be one of {sorted(REMAT_POLICIES)}, "
                 f"got {self.remat_policy!r}")
+        if self.kv_quant not in (None, "int8"):
+            raise ValueError(
+                f"kv_quant must be None or 'int8', got {self.kv_quant!r}")
 
     @property
     def resolved_head_dim(self) -> int:
@@ -360,6 +377,18 @@ class Attention(nn.Module):
                                          _pool_missing)
                 cached_v = self.variable("cache", "cached_value",
                                          _pool_missing)
+                if cfg.kv_quant == "int8":
+                    # Scale siblings exist ONLY under quant so the
+                    # quant-off cache treedef is bit-identical to the
+                    # unquantized engine's. Page dim stays at axis -3
+                    # (matching the pool leaves), so every page-granular
+                    # consumer — gather/scatter shipping, disagg codec,
+                    # trie sharing, TP last-dim sharding — composes
+                    # without special cases.
+                    cached_ks = self.variable("cache", "cached_key_scale",
+                                              _pool_missing)
+                    cached_vs = self.variable("cache", "cached_value_scale",
+                                              _pool_missing)
                 if positions is None:
                     if cache_positions is None:
                         raise ValueError(
@@ -447,10 +476,33 @@ class Attention(nn.Module):
                                      jnp.minimum(blk, n_blocks - 1), axis=1)
             pg = jnp.where(blk >= n_blocks, 0, pg)                # scratch
             off = wpos % page_tokens
-            pool_k = pool_k.at[pg, off].set(
-                k.reshape(b, sq, kv * hd).astype(pool_k.dtype))
-            pool_v = pool_v.at[pg, off].set(
-                v.reshape(b, sq, kv * hd).astype(pool_v.dtype))
+            quant = cfg.kv_quant == "int8"
+            if quant:
+                # Quantize-on-write: per-token-per-head symmetric absmax.
+                # The scatter stays write-local (each token owns its
+                # (page, offset) cell and its scale cell), so there is no
+                # read-modify-write of neighbouring tokens' scales and the
+                # write cost matches the fp path's sliver update.
+                k_w = k.reshape(b, sq, kv, hd).astype(jnp.float32)
+                v_w = v.reshape(b, sq, kv, hd).astype(jnp.float32)
+                k_sc = jnp.max(jnp.abs(k_w), axis=-1) / 127.0     # [B,sq,kv]
+                v_sc = jnp.max(jnp.abs(v_w), axis=-1) / 127.0
+                k_q = jnp.clip(jnp.round(
+                    k_w / jnp.where(k_sc > 0.0, k_sc, 1.0)[..., None]),
+                    -127, 127).astype(jnp.int8)
+                v_q = jnp.clip(jnp.round(
+                    v_w / jnp.where(v_sc > 0.0, v_sc, 1.0)[..., None]),
+                    -127, 127).astype(jnp.int8)
+                pool_k = pool_k.at[pg, off].set(k_q.reshape(b, sq, kv * hd))
+                pool_v = pool_v.at[pg, off].set(v_q.reshape(b, sq, kv * hd))
+                pool_ks = cached_ks.value.at[pg, off].set(k_sc)
+                pool_vs = cached_vs.value.at[pg, off].set(v_sc)
+                cached_ks.value, cached_vs.value = pool_ks, pool_vs
+            else:
+                pool_k = pool_k.at[pg, off].set(
+                    k.reshape(b, sq, kv * hd).astype(pool_k.dtype))
+                pool_v = pool_v.at[pg, off].set(
+                    v.reshape(b, sq, kv * hd).astype(pool_v.dtype))
             cached_k.value, cached_v.value = pool_k, pool_v
             if (cfg.attention_impl == "paged_flash"
                     or (cfg.attention_impl == "auto"
@@ -461,13 +513,28 @@ class Attention(nn.Module):
                 # [B, n_blocks·page_tokens] virtual sequence never
                 # materializes in HBM. Off-TPU "paged_flash" runs the
                 # same kernel in interpret mode (parity tests); "auto"
-                # keeps CPU on the XLA gather below.
+                # keeps CPU on the XLA gather below. Under kv_quant the
+                # kernel fuses the dequant into its page stream: int8
+                # pages and their scale pages ride the same prefetched
+                # block table, so dequantized K/V never hit HBM either.
                 out = pallas_paged_attn.paged_decode_attention(
-                    q, pool_k, pool_v, block_tables, wpos)
+                    q, pool_k, pool_v, block_tables, wpos,
+                    k_scale=pool_ks if quant else None,
+                    v_scale=pool_vs if quant else None)
             else:
                 s_virt = n_blocks * page_tokens
                 k_all = pool_k[block_tables].reshape(b, s_virt, kv, hd)
                 v_all = pool_v[block_tables].reshape(b, s_virt, kv, hd)
+                if quant:
+                    # XLA reference dequant: gathered scales broadcast
+                    # over head_dim; compute re-enters cfg.dtype so the
+                    # attention math matches the fp path's precision.
+                    ks_all = pool_ks[block_tables].reshape(b, s_virt, kv)
+                    vs_all = pool_vs[block_tables].reshape(b, s_virt, kv)
+                    k_all = (k_all.astype(jnp.float32)
+                             * ks_all[..., None]).astype(cfg.dtype)
+                    v_all = (v_all.astype(jnp.float32)
+                             * vs_all[..., None]).astype(cfg.dtype)
                 col = jnp.arange(s_virt)
                 dmask = (col[None, None, :] <= wpos[:, :, None])[:, None]
                 out = attention_ops.multi_head_attention(

@@ -63,8 +63,8 @@ def _compiler_params(interpret):
     return params_cls(dimension_semantics=("parallel", "arbitrary"))
 
 
-def _kernel(tables_ref, q_ref, k_ref, v_ref, pos_ref, o_ref,
-            m_s, l_s, acc_s, *, hkv, group, hd, page_tokens, scale):
+def _kernel(tables_ref, q_ref, k_ref, v_ref, *rest,
+            hkv, group, hd, page_tokens, scale, quant):
     """One (batch row, virtual block) grid cell.
 
     ``tables_ref`` is the scalar-prefetched block table — consumed by the
@@ -73,8 +73,16 @@ def _kernel(tables_ref, q_ref, k_ref, v_ref, pos_ref, o_ref,
     f32, carried across the (sequential) block dimension. Head loops are
     python-static: each (kv head, group member) pair is a static lane
     slice of the folded refs — the pallas_flash per-head idiom, one level
-    up.
+    up. Under ``quant`` two extra refs follow v_ref — the int8 pages'
+    per-token-per-head scale pages ``[1, page_tokens, hkv]``, indexed by
+    the SAME prefetched table entry — and the dequant
+    (``int8 → f32 × scale``) happens on the lane slice in VMEM, fused
+    into the attention math: dequantized K/V never exist in HBM.
     """
+    if quant:
+        ks_ref, vs_ref, pos_ref, o_ref, m_s, l_s, acc_s = rest
+    else:
+        pos_ref, o_ref, m_s, l_s, acc_s = rest
     j = pl.program_id(1)
     n_blocks = pl.num_programs(1)
     sq = q_ref.shape[1]
@@ -98,6 +106,9 @@ def _kernel(tables_ref, q_ref, k_ref, v_ref, pos_ref, o_ref,
         for h in range(hkv):
             k_h = k_ref[0, :, h * hd:(h + 1) * hd]             # [bt, hd]
             v_h = v_ref[0, :, h * hd:(h + 1) * hd]
+            if quant:
+                k_h = k_h.astype(jnp.float32) * ks_ref[0, :, h][:, None]
+                v_h = v_h.astype(jnp.float32) * vs_ref[0, :, h][:, None]
             for t in range(group):
                 qi = h * group + t
                 q_t = q_ref[0, :, qi * hd:(qi + 1) * hd]       # [sq, hd]
@@ -130,6 +141,8 @@ def _kernel(tables_ref, q_ref, k_ref, v_ref, pos_ref, o_ref,
 def paged_decode_attention(q: jax.Array, pool_k: jax.Array,
                            pool_v: jax.Array, block_tables: jax.Array,
                            positions: jax.Array, *,
+                           k_scale: jax.Array | None = None,
+                           v_scale: jax.Array | None = None,
                            softmax_scale: float | None = None,
                            interpret: bool | None = None) -> jax.Array:
     """Grouped-query decode attention straight off the page pool.
@@ -144,6 +157,12 @@ def paged_decode_attention(q: jax.Array, pool_k: jax.Array,
     ``b`` query ``i`` attends virtual columns ``<= positions[b, i]``.
     Returns ``[B, sq, H, hd]`` in q's dtype. ``interpret=None`` picks the
     real kernel on TPU and the Pallas interpreter elsewhere.
+
+    ``k_scale``/``v_scale`` (both or neither) switch on the graftquant
+    int8 path: pool_k/pool_v hold int8 rows and the scales
+    ``[num_pages, page_tokens, kv]`` hold each token's per-head absmax
+    factor; the kernel dequantizes page slices in VMEM, fused into the
+    online softmax.
     """
     if q.ndim != 4:
         raise ValueError(f"q must be [B, sq, H, hd], got {q.shape}")
@@ -167,6 +186,15 @@ def paged_decode_attention(q: jax.Array, pool_k: jax.Array,
     if positions.shape != (b, sq):
         raise ValueError(
             f"positions must be [B={b}, sq={sq}], got {positions.shape}")
+    quant = k_scale is not None or v_scale is not None
+    if quant:
+        if k_scale is None or v_scale is None:
+            raise ValueError("k_scale and v_scale must be passed together")
+        want = pool_k.shape[:2] + (hkv,)
+        if k_scale.shape != want or v_scale.shape != want:
+            raise ValueError(
+                f"k_scale/v_scale must be {want} (per-token-per-head), "
+                f"got {k_scale.shape} / {v_scale.shape}")
     if interpret is None:
         interpret = not on_tpu()
     group = h // hkv
@@ -179,17 +207,27 @@ def paged_decode_attention(q: jax.Array, pool_k: jax.Array,
     pos3 = positions.astype(jnp.int32)[:, None, :]
     tables = block_tables.astype(jnp.int32)
 
+    page_spec = lambda i, j, tbl: (tbl[i, j], 0, 0)
+    in_specs = [
+        pl.BlockSpec((1, sq, h * hd), lambda i, j, tbl: (i, 0, 0)),
+        pl.BlockSpec((1, page_tokens, kvhd), page_spec),
+        pl.BlockSpec((1, page_tokens, kvhd), page_spec),
+    ]
+    operands = [qf, pool_k, pool_v]
+    if quant:
+        # Scale pages ride the same prefetched table entry as their int8
+        # pages — one (page, scale-page) pair per grid cell.
+        in_specs += [pl.BlockSpec((1, page_tokens, hkv), page_spec),
+                     pl.BlockSpec((1, page_tokens, hkv), page_spec)]
+        operands += [k_scale.astype(jnp.float32),
+                     v_scale.astype(jnp.float32)]
+    in_specs.append(pl.BlockSpec((1, 1, sq), lambda i, j, tbl: (i, 0, 0)))
+    operands.append(pos3)
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(b, n_blocks),
-        in_specs=[
-            pl.BlockSpec((1, sq, h * hd), lambda i, j, tbl: (i, 0, 0)),
-            pl.BlockSpec((1, page_tokens, kvhd),
-                         lambda i, j, tbl: (tbl[i, j], 0, 0)),
-            pl.BlockSpec((1, page_tokens, kvhd),
-                         lambda i, j, tbl: (tbl[i, j], 0, 0)),
-            pl.BlockSpec((1, 1, sq), lambda i, j, tbl: (i, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, sq, h * hd), lambda i, j, tbl: (i, 0, 0)),
         scratch_shapes=[
             pltpu.VMEM((h, sq), jnp.float32),
@@ -198,8 +236,10 @@ def paged_decode_attention(q: jax.Array, pool_k: jax.Array,
         ],
     )
     s_virt = n_blocks * page_tokens
+    scale_bytes = (2 * b * s_virt * hkv * 4) if quant else 0
     kernel = functools.partial(_kernel, hkv=hkv, group=group, hd=hd,
-                               page_tokens=page_tokens, scale=scale)
+                               page_tokens=page_tokens, scale=scale,
+                               quant=quant)
     out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
@@ -208,8 +248,9 @@ def paged_decode_attention(q: jax.Array, pool_k: jax.Array,
         cost_estimate=pl.CostEstimate(
             flops=4 * b * h * sq * s_virt * hd,
             bytes_accessed=(qf.size * qf.dtype.itemsize
-                            + 2 * b * s_virt * kvhd * pool_k.dtype.itemsize),
+                            + 2 * b * s_virt * kvhd * pool_k.dtype.itemsize
+                            + scale_bytes),
             transcendentals=b * h * sq * s_virt),
         interpret=interpret,
-    )(tables, qf, pool_k, pool_v, pos3)
+    )(tables, *operands)
     return out.reshape(b, sq, h, hd)

@@ -16,6 +16,7 @@ restore trained parameters in front of this same engine).
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 
 
@@ -214,6 +215,19 @@ def main(argv: list[str] | None = None) -> int:
                          "(>= 1; requires --draft-model). Each iteration "
                          "then emits 1..k+1 tokens per slot, bit-identical "
                          "to non-speculative decoding")
+    ap.add_argument("--kv-quant", choices=["int8"],
+                    default=os.environ.get("TPUJOB_KV_QUANT") or None,
+                    help="quantize the paged KV pool: int8 arenas with "
+                         "per-token-per-head f32 scales, dequantized on "
+                         "read inside the decode kernel (graftquant). "
+                         "Defaults from $TPUJOB_KV_QUANT (launch/render)")
+    ap.add_argument("--weight-quant", choices=["int8"],
+                    default=os.environ.get("TPUJOB_WEIGHT_QUANT") or None,
+                    help="per-output-channel int8 serving weights, "
+                         "dequantized at use inside the compiled programs "
+                         "(matmul kernels only — embeddings, norms and the "
+                         "lm_head stay fp). Defaults from "
+                         "$TPUJOB_WEIGHT_QUANT (launch/render)")
     ap.add_argument("--temperature", type=float, default=0.0)
     ap.add_argument("--top-k", type=int, default=0)
     ap.add_argument("--top-p", type=float, default=1.0)
@@ -497,6 +511,7 @@ def main(argv: list[str] | None = None) -> int:
             request_log=logger, stats=stats,
             draft_model=draft_model, draft_params=draft_params,
             spec_k=args.spec_k, flight=flight, tp=args.tp,
+            kv_quant=args.kv_quant, weight_quant=args.weight_quant,
             prefill_only=(args.role == "prefill"),
             replica_id=(f"r{i}" if args.replicas > 1 or args.autoscale
                         else None))
@@ -513,7 +528,9 @@ def main(argv: list[str] | None = None) -> int:
                 prefix_cache_mb=args.prefix_cache_mb or None,
                 kv_pool_pages=args.kv_pool_pages or None,
                 request_log=logger, stats=stats, flight=flight,
-                tp=args.tp, prefill_only=True, replica_id=f"p{i}")
+                tp=args.tp, kv_quant=args.kv_quant,
+                weight_quant=args.weight_quant,
+                prefill_only=True, replica_id=f"p{i}")
             for i in range(args.disagg_prefill)]
     clients = None
     gateway = None
@@ -644,7 +661,9 @@ def main(argv: list[str] | None = None) -> int:
                     request_log=logger, stats=stats,
                     draft_model=draft_model,
                     draft_params=draft_params,
-                    spec_k=args.spec_k, flight=flight, tp=args.tp)
+                    spec_k=args.spec_k, flight=flight, tp=args.tp,
+                    kv_quant=args.kv_quant,
+                    weight_quant=args.weight_quant)
             autoscale_backend = EngineFactoryBackend(_make_engine)
         discover = None
         if (args.autoscale_k8s_job is not None
@@ -862,6 +881,13 @@ def main(argv: list[str] | None = None) -> int:
                     spec_accepted_tokens=summ["spec_accepted_tokens"],
                     spec_acceptance_rate=summ["spec_acceptance_rate"],
                     spec_accept_hist=summ["spec_accept_hist"])
+    if args.kv_quant or args.weight_quant:
+        summ = stats.summary()
+        logger.emit("quant_summary", kv_quant=args.kv_quant,
+                    weight_quant=args.weight_quant,
+                    kv_quant_bytes_saved=summ["kv_quant_bytes_saved"],
+                    weight_quant_bytes_saved=summ[
+                        "weight_quant_bytes_saved"])
     if tenant_cfgs is not None:
         for e in engines:
             snap = e.queue.snapshot()

@@ -90,6 +90,7 @@ from k8s_distributed_deeplearning_tpu import faults as _faults
 from k8s_distributed_deeplearning_tpu.models import generate
 from k8s_distributed_deeplearning_tpu.parallel import mesh as mesh_lib
 from k8s_distributed_deeplearning_tpu.parallel import sharding as sharding_lib
+from k8s_distributed_deeplearning_tpu.serve import quant as quant_lib
 from k8s_distributed_deeplearning_tpu.serve.page_pool import PagePool
 from k8s_distributed_deeplearning_tpu.serve.prefix_cache import PrefixCache
 from k8s_distributed_deeplearning_tpu.serve.request import (
@@ -141,9 +142,22 @@ def _sample_slots(logits: jax.Array, temps: jax.Array, top_ks: jax.Array,
     return new_keys, toks
 
 
+def _maybe_dequant_params(params: PyTree) -> PyTree:
+    """Weight-quant seam for every compiled program: a quantized param
+    set is the ``(qparams, scales)`` tuple from quant.quantize_params —
+    a STRUCTURAL property, so the branch resolves at trace time and the
+    quant-off programs are byte-identical to HEAD. Dequant runs inside
+    the jit: the fp weights are fused temporaries, the resident copy
+    stays int8."""
+    if quant_lib.is_quantized(params):
+        return quant_lib.dequantize_params(*params)
+    return params
+
+
 def _decode_core(model, params: PyTree, cache: PyTree, tokens: jax.Array,
                  kv_lens: jax.Array, tables: jax.Array, temps: jax.Array,
                  top_ks: jax.Array, top_ps: jax.Array, keys: jax.Array):
+    params = _maybe_dequant_params(params)
     logits, cache = generate.slot_decode_step(model, params, cache, tokens,
                                               kv_lens, block_tables=tables)
     keys, nxt = _sample_slots(logits, temps, top_ks, top_ps, keys)
@@ -169,6 +183,8 @@ def _decode_program(model, params: PyTree, cache: PyTree, tokens: jax.Array,
 def _spec_draft_core(model, params: PyTree, cache: PyTree,
                      tokens: jax.Array, kv_lens: jax.Array,
                      tables: jax.Array, steps: int):
+    params = _maybe_dequant_params(params)
+
     def body(carry, _):
         cache, tok, pos = carry
         logits, cache = generate.slot_decode_step(model, params, cache,
@@ -205,6 +221,7 @@ def _spec_verify_core(model, params: PyTree, cache: PyTree,
                       tables: jax.Array, temps: jax.Array,
                       top_ks: jax.Array, top_ps: jax.Array,
                       keys: jax.Array):
+    params = _maybe_dequant_params(params)
     logits, cache = generate.slot_verify_step(model, params, cache,
                                               window, kv_lens,
                                               block_tables=tables)
@@ -255,6 +272,7 @@ def _leaf_name(path) -> str | None:
 
 def _chunk_core(model, params: PyTree, cache: PyTree, chunk: jax.Array,
                 table: jax.Array, start: jax.Array):
+    params = _maybe_dequant_params(params)
     pos = (start + jnp.arange(chunk.shape[1], dtype=jnp.int32))[None, :]
     _, cache = generate.prefill_chunk(model, params, cache, chunk,
                                       positions=pos, block_tables=table)
@@ -278,6 +296,7 @@ def _final_chunk_core(model, params: PyTree, cache: PyTree,
                       start: jax.Array, length: jax.Array,
                       temp: jax.Array, top_k: jax.Array,
                       top_p: jax.Array, key: jax.Array):
+    params = _maybe_dequant_params(params)
     pos = (start + jnp.arange(chunk.shape[1], dtype=jnp.int32))[None, :]
     logits, cache = generate.prefill_chunk(model, params, cache, chunk,
                                            positions=pos, block_tables=table)
@@ -638,9 +657,30 @@ class ServeEngine:
                  replica_id: str | None = None,
                  draft_model=None, draft_params: PyTree | None = None,
                  spec_k: int = 0, flight: "Any | None" = None,
-                 tp: int = 0, prefill_only: bool = False):
+                 tp: int = 0, prefill_only: bool = False,
+                 kv_quant: str | None = None,
+                 weight_quant: str | None = None):
         if num_slots < 2:
             raise ValueError(f"num_slots must be >= 2, got {num_slots}")
+        for what, mode in (("kv_quant", kv_quant),
+                           ("weight_quant", weight_quant)):
+            if mode not in (None, "int8"):
+                raise ValueError(
+                    f"{what} must be None or 'int8', got {mode!r}")
+        self.kv_quant = kv_quant
+        self.weight_quant = weight_quant
+        if kv_quant is not None and getattr(model, "cfg", None) is not None:
+            # The paged-pool quant path lives in the model's decode branch
+            # (models/transformer.py), keyed on cfg.kv_quant — rebuild the
+            # model (and the draft: its sibling arena shares the page
+            # geometry) with the mode threaded in. Quant-off engines never
+            # touch the cfg, so their programs/cache treedefs stay
+            # byte-identical to an unquantized build.
+            model = model.clone(cfg=dataclasses.replace(
+                model.cfg, kv_quant=kv_quant))
+            if draft_model is not None:
+                draft_model = draft_model.clone(cfg=dataclasses.replace(
+                    draft_model.cfg, kv_quant=kv_quant))
         cfg = getattr(model, "cfg", None)
         max_seq = getattr(cfg, "max_seq_len", None)
         if max_seq is None:
@@ -707,6 +747,21 @@ class ServeEngine:
                 _validate_tp_cfg(dcfg, self.tp, "draft model")
         self.model = model
         self.params = params
+        if self.weight_quant == "int8":
+            qp, sc = quant_lib.quantize_params(params)
+            if self.tp:
+                # _TpPrograms' shard_map in_specs are a params-tree
+                # prefix, which the (qparams, scales) tuple cannot ride
+                # through — TP stores fp weights AT THE INT8 GRID POINTS
+                # (dequantize-at-load): numerics identical to the tp=0
+                # dequant-at-use path, storage benefit forfeited.
+                self.params = quant_lib.dequantize_params(qp, sc)
+            else:
+                self.params = (qp, sc)
+            self._weight_fp_nbytes = quant_lib.params_nbytes(params)
+            self._weight_q_nbytes = quant_lib.quantized_nbytes(qp, sc)
+        else:
+            self._weight_fp_nbytes = self._weight_q_nbytes = 0
         self.num_slots = num_slots
         self.max_seq_len = int(max_seq)
         self.eos_id = eos_id
@@ -806,9 +861,11 @@ class ServeEngine:
         # _block_nbytes.
         dummy = jnp.zeros((1, 1), jnp.int32)
         _, self._row_shapes = jax.eval_shape(
-            lambda p, t: generate.prefill(self.model, p, t),
+            lambda p, t: generate.prefill(self.model,
+                                          _maybe_dequant_params(p), t),
             self.params, dummy)
-        self._cache = self._init_pool_cache(self._row_shapes)
+        self._cache = self._init_pool_cache(
+            self._row_shapes, head_dim=cfg.resolved_head_dim)
         # Speculative decoding: the draft cache is a SECOND paged KV
         # arena over the SAME page indices — block tables, the trie and
         # the refcounts are shared, only the arrays (sized for the draft
@@ -818,14 +875,21 @@ class ServeEngine:
         self.spec_k = int(spec_k)
         self._draft_cache: PyTree | None = None
         if self.spec_k:
+            if self.weight_quant == "int8":
+                dqp, dsc = quant_lib.quantize_params(self.draft_params)
+                self.draft_params = (
+                    quant_lib.dequantize_params(dqp, dsc) if self.tp
+                    else (dqp, dsc))
             if self.tp:
                 self.draft_params = jax.device_put(
                     self.draft_params,
                     self._named_shardings(_tp_param_specs(draft_model)))
             _, draft_shapes = jax.eval_shape(
-                lambda p, t: generate.prefill(self.draft_model, p, t),
+                lambda p, t: generate.prefill(self.draft_model,
+                                              _maybe_dequant_params(p), t),
                 self.draft_params, dummy)
-            self._draft_cache = self._init_pool_cache(draft_shapes)
+            self._draft_cache = self._init_pool_cache(
+                draft_shapes, head_dim=dcfg.resolved_head_dim)
         if self.tp:
             self._tp_programs = _tp_programs_for(
                 _local_tp_model(model, self.tp), self._mesh,
@@ -848,6 +912,15 @@ class ServeEngine:
         self.last_step_prefill_tokens = 0
         self._step_prefill_budget: int | None = None
         self._record_pool_gauges()
+        # Under tp the weights resident on device are fp (dequantized at
+        # load — tuple params can't ride the shard_map in_specs), so the
+        # weight gauge honestly reports 0 saved there.
+        self.stats.record_quant(
+            self.kv_quant, self.weight_quant,
+            kv_bytes_saved=self._kv_bytes_saved(),
+            weight_bytes_saved=(
+                0 if self.tp
+                else self._weight_fp_nbytes - self._weight_q_nbytes))
 
     def _named_shardings(self, specs: PyTree) -> PyTree:
         """PartitionSpec tree -> NamedSharding tree over the tp mesh
@@ -855,7 +928,8 @@ class ServeEngine:
         return jax.tree.map(lambda s: NamedSharding(self._mesh, s), specs,
                             is_leaf=lambda s: isinstance(s, P))
 
-    def _init_pool_cache(self, row_shapes: PyTree) -> PyTree:
+    def _init_pool_cache(self, row_shapes: PyTree, *,
+                         head_dim: int) -> PyTree:
         """Zero-filled page pool with the cache-leaf structure a prefill
         produces (``row_shapes``: the target model's single-row
         eval_shape, or the draft model's for its sibling arena), keeping
@@ -866,8 +940,17 @@ class ServeEngine:
         page. Under tp the pool is built SHARDED-AT-BIRTH along each
         leaf's folded kv·head_dim lane dim (jit + out_shardings): every
         shard materializes only its kv_heads/tp slice of each page, so
-        the full pool never exists on one device."""
+        the full pool never exists on one device.
+
+        Under ``kv_quant="int8"`` the arenas are int8 and each gains a
+        sibling ``*_scale`` leaf ``[..., num_pages, page_tokens, kv]``
+        f32 (``head_dim`` tells the lane split — row_shapes come from
+        the DENSE prefill eval_shape, which carries no quant structure).
+        Page dim stays at axis -3 on both, so gather/scatter shipping,
+        the disagg codec, trie sharing and TP's last-dim sharding (kv is
+        validated tp-divisible) all compose unchanged."""
         bt, pages = self.page_tokens, self.pool.num_pages
+        quant = self.kv_quant == "int8"
 
         def build(tree):
             out = {}
@@ -880,7 +963,13 @@ class ServeEngine:
                     # [1, S, F] -> [P, bt, F]; scanned [L, 1, S, F] ->
                     # [L, P, bt, F] (batch dim 1 at -3 dropped).
                     shape = v.shape[:-3] + (pages, bt) + v.shape[-1:]
-                    out[name] = jnp.zeros(shape, v.dtype)
+                    if quant:
+                        out[name] = jnp.zeros(shape, jnp.int8)
+                        out[name + "_scale"] = jnp.zeros(
+                            shape[:-1] + (shape[-1] // head_dim,),
+                            jnp.float32)
+                    else:
+                        out[name] = jnp.zeros(shape, v.dtype)
             return out
 
         if self._mesh is None:
@@ -891,16 +980,45 @@ class ServeEngine:
         return jax.jit(lambda: build(row_shapes),
                        out_shardings=shardings)()
 
-    def _block_nbytes(self, block_tokens: int) -> int:
+    def _kv_bytes_saved(self) -> int:
+        """HBM bytes the int8 KV arenas (target + draft) save vs the fp
+        pool they replace: each int8 leaf would have cost ``itemsize``
+        per lane in fp, minus the f32 scale siblings' overhead."""
+        if self.kv_quant != "int8":
+            return 0
+        fp_item = jnp.dtype(self.model.cfg.dtype).itemsize
+        saved = 0
+        for tree in (self._cache, self._draft_cache):
+            if tree is None:
+                continue
+            for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+                if _leaf_name(path).endswith("_scale"):
+                    saved -= leaf.size * 4
+                else:
+                    saved += leaf.size * (fp_item - 1)
+        return max(0, saved)
+
+    def _block_nbytes(self, block_tokens: int, *,
+                      kv_quant: str | None = "unset") -> int:
         """Bytes of KV one pool page holds (seq dim of every cached_key/
         cached_value leaf cut to block_tokens) — the trie's exact per-node
-        cost, known without touching device arrays."""
+        cost, known without touching device arrays. Under int8 KV a
+        position costs 1 byte per lane plus a 4-byte f32 scale per KV
+        head instead of ``itemsize`` per lane (``kv_quant`` overrides the
+        engine mode — the bench's fp-vs-int8 bytes/page gate asks both)."""
+        mode = self.kv_quant if kv_quant == "unset" else kv_quant
+        hd = self.model.cfg.resolved_head_dim
         total = 0
         for path, s in jax.tree_util.tree_flatten_with_path(
                 self._row_shapes)[0]:
             if _leaf_name(path) in ("cached_key", "cached_value"):
-                per_pos = int(np.prod(s.shape)) // s.shape[-2]
-                total += per_pos * block_tokens * s.dtype.itemsize
+                lanes = s.shape[-1]
+                lead = int(np.prod(s.shape)) // (s.shape[-2] * lanes)
+                if mode == "int8":
+                    total += lead * block_tokens * (
+                        lanes + (lanes // hd) * 4)
+                else:
+                    total += lead * lanes * block_tokens * s.dtype.itemsize
         return total
 
     def _need_pages(self, req: Request) -> int:
@@ -1114,6 +1232,7 @@ class ServeEngine:
             "prefill_chunks": fl.prefill_chunks,
             "page_tokens": bt,
             "n_pages": nb,
+            "kv_quant": self.kv_quant,
             "pages": staged,
         }
         # Release the slot WITHOUT the terminal path: no on_finish, no
@@ -1154,7 +1273,8 @@ class ServeEngine:
         pool covers the shipped pages plus remaining decode growth
         (evicting unpinned trie pages if that closes the gap)."""
         if (self._draining or self.spec_k
-                or int(blob["page_tokens"]) != self.page_tokens):
+                or int(blob["page_tokens"]) != self.page_tokens
+                or blob.get("kv_quant") != self.kv_quant):
             return False
         if (len(blob["prompt"]) + int(blob["max_new_tokens"])
                 > self.max_seq_len):
@@ -1196,6 +1316,12 @@ class ServeEngine:
                 f"{blob['page_tokens']} tokens, this pool's hold "
                 f"{self.page_tokens} — disagg roles must share "
                 "prefix_block_tokens/min_bucket")
+        if blob.get("kv_quant") != self.kv_quant:
+            raise ValueError(
+                f"kv_quant mismatch: blob pages are "
+                f"{blob.get('kv_quant') or 'fp'}, this pool is "
+                f"{self.kv_quant or 'fp'} — disagg roles must share "
+                "kv_quant (pages ship as raw arena values)")
         emitted = [int(t) for t in blob["emitted"]]
         if not emitted:
             raise ValueError("blob has no emitted tokens — nothing was "
@@ -1816,6 +1942,8 @@ class ServeEngine:
                           if n and out.latency_s > 0 else None),
             spec_proposed=out.spec_proposed,
             spec_accepted=out.spec_accepted,
+            kv_quant=self.kv_quant,
+            weight_quant=self.weight_quant,
             finish_reason=out.finish_reason)
         self.stats.record_request_trace()
 

@@ -752,6 +752,14 @@ class ServeGateway:
             blob = src.export_request_kv(g.req.request_id)
         except (KeyError, ValueError):
             return False        # queued/mid-prefill or speculative slot
+        # The export released the source slot WITHOUT the engine's
+        # terminal path (no completion record), and the later
+        # cancel(..., "migrated") in _evacuate is a no-op on a request
+        # the engine no longer holds — so the migrated-away terminal
+        # reason is recorded here, once per successful export, whether
+        # the shipped import below lands or _migrate resubmits.
+        self.stats.record_completion(latency_s=self._clock() - g.t_submit,
+                                     n_tokens=0, reason="migrated")
         sreq = dataclasses.replace(g.req, migrated_from=h.rid,
                                    _finished=False, _requeued=False)
         sh = _Shadow(target.rid, sreq)

@@ -1,0 +1,143 @@
+"""graftquant — per-channel int8 weight quantization for serving.
+
+Serving weights are read-only, so quantization is a pure storage/bandwidth
+transform: :func:`quantize_params` maps every matmul kernel leaf to int8
+with a per-output-channel symmetric absmax scale, and the engine
+dequantizes AT USE inside its compiled programs (``int8 → f32 × scale``
+fuses into the surrounding HLO; the fp tensor exists only as a fused
+temporary, never as a resident copy). Non-kernel leaves — biases, norms,
+embeddings and anything below 2-D — stay untouched: they are a rounding
+error of the byte budget and the quality-sensitive part of the model.
+
+The contract with the engine (serve/engine.py):
+
+- ``quantize_params(params) -> (qparams, scales)`` where both trees have
+  the SAME treedef as ``params``. Quantized leaves are int8 with an
+  f32 scale of shape ``(1, …, 1, out_channels)`` (broadcastable dequant);
+  passthrough leaves keep their original array and carry a scalar ``0.0``
+  sentinel scale.
+- ``dequantize_params(qparams, scales)`` inverts the pass exactly
+  (dequantized values are the int8 grid points — bit-stable across
+  round-trips, which is what the parity gates key on).
+
+Calibration (optional): ``train/loop.py --quant-calib`` dumps per-channel
+absmax stats as JSON; :func:`load_calibration` reads it and
+``quantize_params(..., calibration=...)`` clips each matching kernel's
+absmax to the calibrated envelope before deriving scales (outlier-robust
+scaling in the AWQ spirit — channels whose live range is narrower than
+the weight extremum get finer grids).
+"""
+from __future__ import annotations
+
+import json
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+PyTree = Any
+
+# Leaves below this rank are never quantized (biases, scalars).
+_MIN_QUANT_NDIM = 2
+
+
+def _path_name(path) -> str:
+    """'params/layers/attn/q_proj/kernel'-style key for calibration lookup."""
+    parts = []
+    for p in path:
+        key = getattr(p, "key", None)
+        if key is None:
+            key = getattr(p, "name", None)
+        if key is None:
+            key = getattr(p, "idx", None)
+        parts.append(str(key))
+    return "/".join(parts)
+
+
+def _quantizable(path, leaf) -> bool:
+    """Matmul kernels only. Norm scales can be >= 2-D here (scanned
+    layers fold a leading layer axis in), embeddings are lookup tables,
+    and the lm_head writes the logits argmax reads — quantizing any of
+    them trades the quality budget for a rounding error of the byte
+    budget. The projection kernels are where the bytes are."""
+    name = _path_name(path)
+    return ("kernel" in name and "lm_head" not in name
+            and hasattr(leaf, "ndim") and leaf.ndim >= _MIN_QUANT_NDIM
+            and jnp.issubdtype(leaf.dtype, jnp.floating))
+
+
+def quantize_params(params: PyTree, calibration: dict | None = None
+                    ) -> tuple[PyTree, PyTree]:
+    """Per-output-channel symmetric int8 quantization of serving params.
+
+    Returns ``(qparams, scales)`` with the same treedef as *params*.
+    Matmul kernel leaves (see :func:`_quantizable`) become int8 with scale
+    ``absmax(over all axes but the last) / 127`` kept broadcastable
+    (``(1, …, 1, out)``); everything else passes through with a scalar
+    ``0.0`` sentinel scale — :func:`dequantize_params` and the engine's
+    dequant-at-use treat the sentinel as "leaf is not quantized".
+    """
+    calib = (calibration or {}).get("weights", {})
+
+    def one(path, leaf):
+        if not _quantizable(path, leaf):
+            return leaf, jnp.float32(0.0)
+        w = jnp.asarray(leaf, jnp.float32)
+        absmax = jnp.max(jnp.abs(w), axis=tuple(range(w.ndim - 1)),
+                         keepdims=True)
+        cal = calib.get(_path_name(path))
+        if cal is not None:
+            cal = jnp.asarray(cal, jnp.float32).reshape(absmax.shape)
+            absmax = jnp.minimum(absmax, cal)
+        scale = absmax / 127.0
+        q = jnp.clip(jnp.round(w / jnp.where(scale > 0.0, scale, 1.0)),
+                     -127, 127).astype(jnp.int8)
+        return q, scale
+
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    treedef = jax.tree_util.tree_structure(params)
+    pairs = [one(p, l) for p, l in flat]
+    qparams = jax.tree_util.tree_unflatten(treedef, [q for q, _ in pairs])
+    scales = jax.tree_util.tree_unflatten(treedef, [s for _, s in pairs])
+    return qparams, scales
+
+
+def dequantize_params(qparams: PyTree, scales: PyTree) -> PyTree:
+    """Invert :func:`quantize_params` (jit-safe — the engine calls this
+    inside its compiled programs so the fp weights are fused temporaries)."""
+    def one(q, s):
+        if getattr(s, "ndim", 0) == 0:          # sentinel: passthrough leaf
+            return q
+        return q.astype(jnp.float32) * s
+    return jax.tree.map(one, qparams, scales)
+
+
+def is_quantized(params) -> bool:
+    """Structural check the engine's cores branch on at TRACE time: a
+    quantized param set is the ``(qparams, scales)`` 2-tuple, a plain one
+    is the usual dict/FrozenDict."""
+    return isinstance(params, tuple) and len(params) == 2
+
+
+def quantized_nbytes(qparams: PyTree, scales: PyTree) -> int:
+    """Device bytes of the quantized representation (int8 + scales +
+    passthrough leaves) — the telemetry/bench accounting."""
+    total = 0
+    for leaf in jax.tree.leaves(qparams) + jax.tree.leaves(scales):
+        total += leaf.size * leaf.dtype.itemsize
+    return total
+
+
+def params_nbytes(params: PyTree) -> int:
+    return sum(l.size * l.dtype.itemsize for l in jax.tree.leaves(params))
+
+
+def load_calibration(path: str) -> dict:
+    """Read a ``train/loop.py --quant-calib`` JSON dump: ``{"weights":
+    {param_path: [per-channel absmax]}, "activations": {...}}``."""
+    with open(path) as f:
+        calib = json.load(f)
+    if not isinstance(calib, dict) or "weights" not in calib:
+        raise ValueError(
+            f"{path}: not a calibration dump (missing 'weights' key)")
+    return calib

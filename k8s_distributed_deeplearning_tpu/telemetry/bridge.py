@@ -208,7 +208,23 @@ def serving_collector(registry: MetricsRegistry,
         "serve_spec_acceptance_rate": registry.gauge(
             "serve_spec_acceptance_rate",
             "fraction of proposed draft tokens accepted and emitted"),
+        "serve_kv_quant_bytes_saved": registry.gauge(
+            "serve_kv_quant_bytes_saved",
+            "HBM bytes the int8 KV pool saves vs its fp equivalent "
+            "(arena shrink minus the f32 scale siblings' overhead; "
+            "0 when kv_quant is off)"),
+        "serve_weight_quant_bytes_saved": registry.gauge(
+            "serve_weight_quant_bytes_saved",
+            "device bytes the int8 serving weights save vs fp params "
+            "(0 when weight_quant is off, or under tp where resident "
+            "weights stay fp)"),
     }
+    quant_mode = registry.gauge(
+        "serve_quant_mode",
+        "active quantization mode as a 0/1 flag per (kind, mode) label "
+        "pair — Prometheus gauges are numeric, so the mode string rides "
+        "the label, not the value",
+        labelnames=("kind", "mode"))
     spec_hist = registry.gauge(
         "serve_spec_accepted_per_step",
         "slot-iterations by accepted-draft count (0..spec_k) — the "
@@ -259,7 +275,9 @@ def serving_collector(registry: MetricsRegistry,
                "spec_acceptance_rate": "serve_spec_acceptance_rate",
                "transport_retries": "serve_transport_retries_total",
                "transport_dedup_hits": "serve_transport_dedup_hits_total",
-               "transport_reconnects": "serve_transport_reconnects_total"}
+               "transport_reconnects": "serve_transport_reconnects_total",
+               "kv_quant_bytes_saved": "serve_kv_quant_bytes_saved",
+               "weight_quant_bytes_saved": "serve_weight_quant_bytes_saved"}
 
     def collect() -> None:
         summ = stats.summary()
@@ -273,6 +291,9 @@ def serving_collector(registry: MetricsRegistry,
             spec_hist.labels(accepted=str(accepted)).set(float(count))
         for owner, count in summ.get("kv_pages_by_owner", {}).items():
             pages_by_owner.labels(owner=str(owner)).set(float(count))
+        for kind in ("kv", "weight"):
+            mode = summ.get(f"{kind}_quant") or "off"
+            quant_mode.labels(kind=kind, mode=str(mode)).set(1.0)
 
     registry.register_collector(collect)
 

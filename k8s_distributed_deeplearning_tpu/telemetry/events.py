@@ -68,6 +68,11 @@ EVENTS: dict[str, str] = {
     "spec_summary": "end-of-run speculative-decoding aggregate: draft "
                     "tokens proposed/accepted, acceptance rate, "
                     "accepted-per-step histogram",
+    "quant_summary": "end-of-run graftquant aggregate: active kv/weight "
+                     "quant modes and the HBM bytes each saved vs fp",
+    "quant_calib": "the training loop wrote a graftquant calibration "
+                   "dump (per-channel weight absmax stats; path and "
+                   "entry count attached)",
     "flight_dump": "the flight recorder wrote (or was asked for) a ring "
                    "dump: reason (breaker_trip/drain/sigterm/fault/"
                    "on_demand), record count, dump path",

@@ -32,6 +32,33 @@ PyTree = Any
 _NULL_TRACER = Tracer(enabled=False)
 
 
+def dump_quant_calibration(params: PyTree, path: str) -> int:
+    """Write per-channel absmax stats for every quantizable kernel leaf
+    as the JSON envelope ``serve.quant.load_calibration`` reads —
+    ``{"weights": {param_path: [per-output-channel absmax]}}`` with keys
+    from the SAME path naming ``quantize_params`` uses for its lookup,
+    so a dump from the training run clips the serving scales without any
+    name translation. Returns the number of entries written."""
+    import json
+
+    import numpy as np
+
+    from k8s_distributed_deeplearning_tpu.serve import quant as quant_lib
+
+    weights = {}
+    for p, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
+        if not quant_lib._quantizable(p, leaf):
+            continue
+        # graftlint: disable=host-sync — calibration is an end-of-run
+        # dump, not hot-loop work.
+        w = np.asarray(leaf, np.float32)
+        absmax = np.max(np.abs(w), axis=tuple(range(w.ndim - 1)))
+        weights[quant_lib._path_name(p)] = absmax.reshape(-1).tolist()
+    with open(path, "w") as f:
+        json.dump({"weights": weights}, f)
+    return len(weights)
+
+
 # graftlint: hot-path
 def fit(
     step_fn: Callable,                # (state, batch, rng) -> (state, loss, aux)
@@ -54,6 +81,7 @@ def fit(
     tracer: Tracer | None = None,
     heartbeat: HeartbeatWriter | None = None,
     telemetry: "Any | None" = None,   # telemetry.bridge.TrainTelemetry
+    quant_calib: str | None = None,   # JSON path for graftquant stats
 ) -> PyTree:
     """Run synchronous training for ``num_steps``; returns the final state.
 
@@ -92,6 +120,13 @@ def fit(
     watch --heartbeat-dir`` turns a stale file into a named stalled rank.
     *telemetry*: a :class:`telemetry.bridge.TrainTelemetry` whose gauges
     update at the ``log_every`` cadence for the ``/metrics`` scrape.
+
+    *quant_calib*: path for a graftquant calibration dump — on normal
+    completion the primary writes the final params' per-channel absmax
+    stats as JSON (:func:`dump_quant_calibration`), which
+    ``serve.quant.quantize_params(calibration=...)`` uses to clip its
+    int8 scales. Preempted runs skip the dump: half-trained stats would
+    silently mis-calibrate the serving weights.
     """
     inj = _faults.active()
     start_step = 0
@@ -214,6 +249,12 @@ def fit(
             checkpointer.wait()
             inj.fire("checkpoint_saved", step=num_steps,
                      path=checkpointer.directory)
+    if quant_calib is not None and distributed.is_primary():
+        n = dump_quant_calibration(getattr(state, "params", state),
+                                   quant_calib)
+        if metrics:
+            metrics.emit("quant_calib", step=num_steps, path=quant_calib,
+                         entries=n)
     return state
 
 

@@ -165,6 +165,15 @@ class ServingStats:
         self.disagg_fallbacks = 0
         self.disagg_prefill_depth = 0
         self.disagg_decode_depth = 0
+        # Quantized serving (graftquant): active modes (None = fp) and
+        # the HBM bytes the quantized representation saves vs fp — KV
+        # pool (full-arena fp-equivalent minus int8+scales) plus int8
+        # weights (fp params minus int8+scales). Gauges, set once at
+        # engine construction.
+        self.kv_quant: str | None = None
+        self.weight_quant: str | None = None
+        self.kv_quant_bytes_saved = 0
+        self.weight_quant_bytes_saved = 0
 
     def _tick(self) -> None:
         now = time.perf_counter()
@@ -319,6 +328,16 @@ class ServingStats:
         self.disagg_prefill_depth = int(prefill)
         self.disagg_decode_depth = int(decode)
 
+    def record_quant(self, kv_quant: str | None, weight_quant: str | None,
+                     kv_bytes_saved: int, weight_bytes_saved: int) -> None:
+        """Quantization configuration gauge, set once when the engine
+        builds its pool/params. NO ``_tick()`` — construction is not
+        serving activity."""
+        self.kv_quant = kv_quant
+        self.weight_quant = weight_quant
+        self.kv_quant_bytes_saved = int(kv_bytes_saved)
+        self.weight_quant_bytes_saved = int(weight_bytes_saved)
+
     def record_completion(self, latency_s: float, n_tokens: int,
                           reason: str) -> None:
         self._tick()
@@ -382,6 +401,10 @@ class ServingStats:
             "disagg_fallbacks": self.disagg_fallbacks,
             "disagg_prefill_depth": self.disagg_prefill_depth,
             "disagg_decode_depth": self.disagg_decode_depth,
+            "kv_quant": self.kv_quant,
+            "weight_quant": self.weight_quant,
+            "kv_quant_bytes_saved": self.kv_quant_bytes_saved,
+            "weight_quant_bytes_saved": self.weight_quant_bytes_saved,
             "spec_steps": self.spec_steps,
             "spec_proposed_tokens": self.spec_proposed_tokens,
             "spec_accepted_tokens": self.spec_accepted_tokens,

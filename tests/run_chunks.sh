@@ -92,9 +92,14 @@ CHUNKS[storm]="tests/test_storm.py"
 # every engine program three times (tp 0/1/2) under shard_map — its own
 # chunk so serve/spec stay under their timeouts.
 CHUNKS[tp]="tests/test_tp_serve.py"
+# graftquant (int8 KV pages + int8 serving weights): kernel-vs-reference
+# numerics, the greedy-agreement gate, and a composition matrix (spec/
+# prefix/chunked/disagg/tp=2) that compiles several quant engines — its
+# own chunk so serve/spec/tp stay under their timeouts.
+CHUNKS[quant]="tests/test_quant.py"
 CHUNKS[slow1]="tests/test_train_e2e.py tests/test_multiprocess.py"
 CHUNKS[slow2]="tests/test_multihost_train.py tests/test_multihost_llama.py tests/test_train_zoo.py"
-ORDER=(lint core parallel1 parallel2 moe train llama deploy serve sched paged faults graftscope fleet gateway spec flight transport autoscale disagg storm tp slow1 slow2)
+ORDER=(lint core parallel1 parallel2 moe train llama deploy serve sched paged faults graftscope fleet gateway spec flight transport autoscale disagg storm tp quant slow1 slow2)
 
 # --- completeness check: every tests/test_*.py in EXACTLY one chunk ------
 # ...and every declared chunk actually in ORDER: a chunk missing from the

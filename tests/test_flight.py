@@ -72,7 +72,7 @@ class TestPageLedger:
         pool = PagePool(8, 4)
         pages = pool.alloc(3)
         assert pool.owners_summary() == {"slot": 3, "trie": 0, "draft": 0,
-                                         "reserved": 0}
+                                         "imported": 0, "reserved": 0}
         for p in pages:
             assert pool.refcount(p) == 1
 
@@ -88,7 +88,7 @@ class TestPageLedger:
         (p,) = pool.alloc(1)
         pool.deref(p)
         assert pool.owners_summary() == {"slot": 0, "trie": 0, "draft": 0,
-                                         "reserved": 0}
+                                         "imported": 0, "reserved": 0}
         assert pool.refcount(p) == 0
 
     def test_shared_page_keeps_one_tag(self):
@@ -135,7 +135,8 @@ class TestPageLedger:
         assert "free" not in held
 
     def test_owner_vocabulary(self):
-        assert OWNERS == ("free", "slot", "trie", "draft", "scratch")
+        assert OWNERS == ("free", "slot", "trie", "draft", "scratch",
+                          "imported")
 
 
 # --------------------------------------------------- FlightRecorder
@@ -573,7 +574,7 @@ class TestEngineFlight:
                     "pool_owners", "last_decode_ms", "draining"):
             assert key in rec
         assert set(rec["pool_owners"]) == {"slot", "trie", "draft",
-                                           "reserved"}
+                                           "imported", "reserved"}
 
     def test_trace_id_survives_resume(self, tiny):
         (r,) = _requests(tiny[2], 1)
