@@ -6,8 +6,10 @@ branch on a traced value recompiling every step, a rank-divergent clock
 read in collectively-executed code) are statically detectable. This
 package detects them: a dependency-free, pure-AST lint framework with a
 context-aware walker (traced regions, shard_map axis scopes, hot paths)
-and six pluggable passes. It must never import jax — the full tree lints
-in seconds on any box.
+and eight pluggable passes — six JAX/registry hazard classes plus the
+graftguard concurrency layers (lock discipline over the threaded serving
+stack, resource-lifecycle pairing over the page economy). It must never
+import jax — the full tree lints in seconds on any box.
 
 Run it::
 
@@ -28,7 +30,8 @@ import dataclasses
 import os
 
 from k8s_distributed_deeplearning_tpu.analysis.core import (  # noqa: F401
-    Finding, ModuleInfo, SEVERITY_ERROR, SEVERITY_WARNING, load_modules)
+    Finding, ModuleInfo, SEVERITY_ERROR, SEVERITY_WARNING, iter_py_files,
+    load_modules)
 from k8s_distributed_deeplearning_tpu.analysis.passes import (  # noqa: F401
     PASSES, PASS_IDS, Project, fault_sites_in_tree)
 
@@ -56,6 +59,38 @@ def default_paths() -> list[str]:
     if os.path.isdir(examples):
         paths.append(examples)
     return paths
+
+
+def changed_paths(ref: str = "HEAD",
+                  scan_paths: list[str] | None = None) -> list[str]:
+    """The ``--changed`` file list: ``.py`` files touched vs git *ref*
+    (tracked diff plus untracked files), intersected with the scan set
+    (*scan_paths*, default :func:`default_paths`) so the exit-code
+    contract matches a full run restricted to those files. Raises
+    RuntimeError when git is unavailable or *ref* does not resolve."""
+    import subprocess
+    top = subprocess.run(["git", "rev-parse", "--show-toplevel"],
+                         capture_output=True, text=True)
+    if top.returncode != 0:
+        raise RuntimeError(
+            f"--changed needs a git checkout: {top.stderr.strip()}")
+    root = top.stdout.strip()
+    diff = subprocess.run(["git", "diff", "--name-only", "-z", ref, "--"],
+                          cwd=root, capture_output=True, text=True)
+    if diff.returncode != 0:
+        raise RuntimeError(
+            f"git diff vs {ref!r} failed: {diff.stderr.strip()}")
+    untracked = subprocess.run(
+        ["git", "ls-files", "--others", "--exclude-standard", "-z"],
+        cwd=root, capture_output=True, text=True)
+    names = diff.stdout.split("\0")
+    if untracked.returncode == 0:
+        names += untracked.stdout.split("\0")
+    changed = {os.path.abspath(os.path.join(root, n))
+               for n in names if n.endswith(".py")}
+    scan = {os.path.abspath(p)
+            for p in iter_py_files(scan_paths or default_paths())}
+    return sorted(changed & scan)
 
 
 def run(paths: list[str] | None = None,

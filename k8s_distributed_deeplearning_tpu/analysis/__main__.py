@@ -4,6 +4,8 @@ Usage::
 
     python -m k8s_distributed_deeplearning_tpu.analysis [paths...]
     graftlint [paths...] [--select=id,id] [--json] [--show-suppressed]
+    graftlint --changed[=REF]      # only files touched vs REF (def. HEAD)
+    graftlint --explain PASS       # a pass's checks/exemptions/token
     graftlint --list-passes
 
 Exit codes (the contract ``tests/test_analysis.py`` pins):
@@ -16,6 +18,7 @@ Exit codes (the contract ``tests/test_analysis.py`` pins):
 from __future__ import annotations
 
 import argparse
+import inspect
 import json
 import sys
 
@@ -39,6 +42,15 @@ def main(argv: list[str] | None = None) -> int:
                         help="also print suppressed findings")
     parser.add_argument("--list-passes", action="store_true",
                         help="list pass ids and what they catch")
+    parser.add_argument("--changed", nargs="?", const="HEAD", default=None,
+                        metavar="REF",
+                        help="lint only files changed vs git REF (default "
+                             "HEAD: working tree + untracked), intersected "
+                             "with the scan set; exit codes as in a full "
+                             "run")
+    parser.add_argument("--explain", default=None, metavar="PASS",
+                        help="print one pass's checks, exemption rules, "
+                             "and suppression token (from its docstring)")
     try:
         args = parser.parse_args(argv)
     except SystemExit as e:
@@ -50,14 +62,43 @@ def main(argv: list[str] | None = None) -> int:
             print(f"{spec.id:18s} {spec.doc}")
         return 0
 
+    if args.explain is not None:
+        spec = next((s for s in analysis.PASSES if s.id == args.explain),
+                    None)
+        if spec is None:
+            print(f"graftlint: unknown pass {args.explain!r} "
+                  f"(known: {', '.join(analysis.PASS_IDS)})",
+                  file=sys.stderr)
+            return 2
+        print(f"{spec.id} — {spec.doc}")
+        print()
+        print(inspect.getdoc(spec.fn) or "(no docstring)")
+        print()
+        print(f"suppress with: # graftlint: disable={spec.id}")
+        return 0
+
     select = tuple(s.strip() for s in args.select.split(",") if s.strip())
     import os
     for p in args.paths:
         if not os.path.exists(p):
             print(f"graftlint: no such path: {p}", file=sys.stderr)
             return 2
+    run_paths = args.paths or None
+    if args.changed is not None:
+        try:
+            run_paths = analysis.changed_paths(args.changed, run_paths)
+        except RuntimeError as e:
+            print(f"graftlint: {e}", file=sys.stderr)
+            return 2
+        if not run_paths:
+            # Nothing in the scan set changed — trivially clean, same
+            # output/exit contract as an empty full run.
+            run_paths = []
     try:
-        report = analysis.run(args.paths or None, select=select or None)
+        if run_paths == []:
+            report = analysis.Report(findings=(), suppressed=())
+        else:
+            report = analysis.run(run_paths, select=select or None)
     except ValueError as e:
         print(f"graftlint: {e}", file=sys.stderr)
         return 2

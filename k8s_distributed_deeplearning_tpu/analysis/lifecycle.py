@@ -1,0 +1,391 @@
+"""graftlint pass 8: resource-lifecycle pairing over the page economy.
+
+The serving stack runs a manual resource economy: ``PagePool`` hands out
+refcounted KV pages (``alloc``/``alloc_reserved``/``ref`` balanced by
+``deref``), a reservation counter (``reserve``/``unreserve``), the
+scheduler lends slot quota (``pop`` balanced by ``release``), and the
+prefix trie pins node chains (``acquire`` balanced by post-splice
+``release``). A single exception edge between acquire and handoff leaks
+pages forever — exactly the bug class graftstorm catches only after a
+long soak.
+
+This pass declares those obligations in a small contract registry and
+checks every call site over an exception-edge-aware walk of each
+function: an acquire whose result can flow into a ``raise`` or ``return``
+edge before the value is released *or handed off* is a leak finding.
+
+Handoff (discharge) is deliberately lenient — any later use of the bound
+value (stored into object state, passed to a call, returned) counts,
+because ownership transfer in this codebase is always a store or a call.
+The checks that remain sharp:
+
+* acquire whose result is discarded outright (``pool.alloc(4)`` as a
+  bare statement) — leaked at birth;
+* ``raise``/``return`` strictly between the acquire and the first use of
+  the bound value — the exception-edge leak;
+* a counter acquire (``reserve``) with no matching ``unreserve``
+  anywhere in the scanned tree;
+* a value acquire for a contract with zero matching release calls
+  anywhere in the scanned tree.
+
+Exemptions: a ``return``/``raise`` inside an ``if x is None:`` /
+``if not x:`` guard on the bound name (the pop-may-return-None idiom);
+edges inside a ``try`` whose handler or ``finally`` performs the
+matching release (the rollback idiom); names loaded only in ``if``/
+``while`` tests do not count as discharge (a condition read is not a
+handoff). Contract implementation classes are naturally exempt because
+internal calls go through ``self``, which never matches a contract
+receiver keyword.
+
+Suppress a deliberate imbalance with ``# graftlint:
+disable=resource-lifecycle`` plus an in-line justification.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+
+from k8s_distributed_deeplearning_tpu.analysis.core import (
+    Finding, SEVERITY_ERROR, SEVERITY_WARNING, name_tail)
+
+PASS_ID = "resource-lifecycle"
+
+_INF = 10 ** 9
+
+
+@dataclasses.dataclass(frozen=True)
+class ResourceContract:
+    """A pairing obligation: calls named *acquire* on a receiver matching
+    *receivers* must be balanced by a *release*-named call. ``value``
+    contracts return the resource (track the bound name); counter
+    contracts just bump a ledger (check pairing presence)."""
+    name: str
+    acquire: frozenset[str]
+    release: frozenset[str]
+    receivers: tuple[str, ...]
+    value: bool
+
+
+CONTRACTS: tuple[ResourceContract, ...] = (
+    ResourceContract("pool-page",
+                     frozenset({"alloc", "alloc_reserved", "ref"}),
+                     frozenset({"deref"}), ("pool",), True),
+    ResourceContract("pool-reservation",
+                     frozenset({"reserve"}),
+                     frozenset({"unreserve"}), ("pool",), False),
+    ResourceContract("slot-quota",
+                     frozenset({"pop"}),
+                     frozenset({"release"}), ("queue", "sched"), True),
+    ResourceContract("trie-pin",
+                     frozenset({"acquire"}),
+                     frozenset({"release"}),
+                     ("prefix_cache", "trie", "cache"), True),
+)
+
+# Acquire tails whose discarded result is a leak at birth (ref-style
+# acquires take the resource as an argument instead).
+_BINDING_ACQUIRES = frozenset({"alloc", "alloc_reserved", "pop", "acquire"})
+
+
+def _receiver_tail(call: ast.Call) -> str | None:
+    if not isinstance(call.func, ast.Attribute):
+        return None
+    return name_tail(call.func.value)
+
+
+def _contract_for(call: ast.Call, kind: str) -> ResourceContract | None:
+    if not isinstance(call.func, ast.Attribute):
+        return None
+    recv = (_receiver_tail(call) or "").lower()
+    if not recv or recv == "self":
+        return None
+    attr = call.func.attr
+    for c in CONTRACTS:
+        tails = c.acquire if kind == "acquire" else c.release
+        if attr in tails and any(k in recv for k in c.receivers):
+            return c
+    return None
+
+
+def _base_name(e: ast.expr) -> str | None:
+    while isinstance(e, (ast.Subscript, ast.Attribute, ast.Starred)):
+        e = e.value
+    return e.id if isinstance(e, ast.Name) else None
+
+
+def _none_guard_names(test: ast.expr) -> frozenset[str]:
+    """Names X for which *test* is an ``X is None`` / ``not X`` guard."""
+    if (isinstance(test, ast.Compare) and isinstance(test.left, ast.Name)
+            and len(test.ops) == 1 and isinstance(test.ops[0], ast.Is)
+            and isinstance(test.comparators[0], ast.Constant)
+            and test.comparators[0].value is None):
+        return frozenset({test.left.id})
+    if (isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not)
+            and isinstance(test.operand, ast.Name)):
+        return frozenset({test.operand.id})
+    return frozenset()
+
+
+@dataclasses.dataclass
+class _Acquire:
+    contract: ResourceContract
+    line: int
+    bound: frozenset[str]
+    discharged: bool          # ownership consumed at the acquire site
+    discarded: bool           # result dropped on the floor
+
+
+@dataclasses.dataclass
+class _Edge:
+    line: int
+    kind: str                 # "return" | "raise"
+    guards: frozenset[str]    # None-guarded names on this branch
+    cleanup: frozenset[str]   # contract names released by enclosing
+                              # try handlers/finallys
+
+
+class _FnScan:
+    """One function's acquire sites, name loads, and exit edges."""
+
+    def __init__(self, fnode: ast.AST):
+        self.acquires: list[_Acquire] = []
+        self.loads: list[tuple[str, int]] = []
+        self.edges: list[_Edge] = []
+        self.releases: list[ResourceContract] = []
+        self._visit_stmts(
+            fnode.body, frozenset(), frozenset())
+
+    # -- statement walk ----------------------------------------------
+
+    def _visit_stmts(self, stmts, guards, cleanup) -> None:
+        for st in stmts:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+                continue
+            if isinstance(st, ast.If):
+                self._scan_calls(st.test)
+                g = _none_guard_names(st.test)
+                self._visit_stmts(st.body, guards | g, cleanup)
+                self._visit_stmts(st.orelse, guards, cleanup)
+            elif isinstance(st, ast.While):
+                self._scan_calls(st.test)
+                self._visit_stmts(st.body, guards, cleanup)
+                self._visit_stmts(st.orelse, guards, cleanup)
+            elif isinstance(st, (ast.For, ast.AsyncFor)):
+                self._scan_expr(st.iter)
+                self._visit_stmts(st.body, guards, cleanup)
+                self._visit_stmts(st.orelse, guards, cleanup)
+            elif isinstance(st, (ast.With, ast.AsyncWith)):
+                for item in st.items:
+                    self._scan_expr(item.context_expr)
+                self._visit_stmts(st.body, guards, cleanup)
+            elif isinstance(st, ast.Try):
+                extra = self._cleanup_contracts(st)
+                self._visit_stmts(st.body, guards, cleanup | extra)
+                for h in st.handlers:
+                    self._visit_stmts(h.body, guards, cleanup | extra)
+                self._visit_stmts(st.orelse, guards, cleanup | extra)
+                self._visit_stmts(st.finalbody, guards, cleanup)
+            elif isinstance(st, ast.Return):
+                if st.value is not None:
+                    self._scan_expr(st.value)
+                self.edges.append(_Edge(st.lineno, "return", guards, cleanup))
+            elif isinstance(st, ast.Raise):
+                if st.exc is not None:
+                    self._scan_expr(st.exc)
+                self.edges.append(_Edge(st.lineno, "raise", guards, cleanup))
+            elif isinstance(st, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                self._visit_assign(st)
+            elif isinstance(st, ast.Expr):
+                self._visit_expr_stmt(st)
+            else:
+                self._scan_expr(st)
+
+    def _cleanup_contracts(self, trynode: ast.Try) -> frozenset[str]:
+        """Contract names whose release appears in this try's handlers or
+        finally — the rollback idiom legitimizing edges in its body."""
+        found = set()
+        bodies = list(trynode.finalbody)
+        for h in trynode.handlers:
+            bodies.extend(h.body)
+        for b in bodies:
+            for n in ast.walk(b):
+                if isinstance(n, ast.Call):
+                    c = _contract_for(n, "release")
+                    if c is not None:
+                        found.add(c.name)
+        return frozenset(found)
+
+    # -- expression scans --------------------------------------------
+
+    def _visit_assign(self, st) -> None:
+        value = st.value
+        if value is None:                       # bare AnnAssign
+            return
+        targets = st.targets if isinstance(st, ast.Assign) else [st.target]
+        if isinstance(value, ast.Call):
+            c = _contract_for(value, "acquire")
+            if c is not None and c.value:
+                bound: set[str] = set()
+                name_binding = True
+                for t in targets:
+                    if isinstance(t, ast.Name):
+                        bound.add(t.id)
+                    elif isinstance(t, ast.Tuple) and all(
+                            isinstance(e, ast.Name) for e in t.elts):
+                        bound |= {e.id for e in t.elts}
+                    else:
+                        name_binding = False
+                self.acquires.append(_Acquire(
+                    c, value.lineno, frozenset(bound),
+                    discharged=not name_binding or not bound,
+                    discarded=False))
+                self._scan_expr_skip_acquires(value)
+                for t in targets:
+                    self._scan_expr(t)
+                return
+        self._scan_expr(st)
+
+    def _visit_expr_stmt(self, st: ast.Expr) -> None:
+        value = st.value
+        if isinstance(value, ast.Call):
+            c = _contract_for(value, "acquire")
+            if c is not None and c.value:
+                attr = value.func.attr  # type: ignore[union-attr]
+                args = [_base_name(a) for a in value.args]
+                args += [_base_name(kw.value) for kw in value.keywords]
+                bound = frozenset(a for a in args if a)
+                if attr in _BINDING_ACQUIRES:
+                    self.acquires.append(_Acquire(
+                        c, value.lineno, frozenset(), discharged=False,
+                        discarded=True))
+                else:
+                    # ref-style: the resource is the argument; its
+                    # lifetime obligation rides on those names.
+                    self.acquires.append(_Acquire(
+                        c, value.lineno, bound,
+                        discharged=not bound, discarded=False))
+                self._scan_expr_skip_acquires(value)
+                return
+        self._scan_expr(st)
+
+    def _scan_expr(self, node: ast.AST) -> None:
+        """Record Name loads, nested acquire/release calls."""
+        for n in ast.walk(node):
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda, ast.ClassDef)):
+                continue
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load):
+                self.loads.append((n.id, n.lineno))
+            elif isinstance(n, ast.Call):
+                self._note_call(n)
+
+    def _scan_expr_skip_acquires(self, call: ast.Call) -> None:
+        """Scan an acquire call's arguments for loads without re-noting
+        the acquire itself (its arg loads share its line and never count
+        as discharge anyway)."""
+        for a in list(call.args) + [kw.value for kw in call.keywords]:
+            self._scan_expr(a)
+
+    def _scan_calls(self, node: ast.AST) -> None:
+        """If/while tests: note calls (an acquire in a test is still an
+        acquire) but record no loads — a condition read is not a
+        handoff."""
+        for n in ast.walk(node):
+            if isinstance(n, ast.Call):
+                self._note_call(n)
+
+    def _note_call(self, n: ast.Call) -> None:
+        c = _contract_for(n, "release")
+        if c is not None:
+            self.releases.append(c)
+            return
+        c = _contract_for(n, "acquire")
+        if c is None:
+            return
+        if c.value:
+            # Result consumed by the enclosing expression (subscripted,
+            # passed to a call, part of a container literal): ownership
+            # moved at the acquire site.
+            self.acquires.append(_Acquire(
+                c, n.lineno, frozenset(), discharged=True, discarded=False))
+        else:
+            self.acquires.append(_Acquire(
+                c, n.lineno, frozenset(), discharged=True, discarded=False))
+
+
+def pass_resource_lifecycle(project) -> list[Finding]:
+    """Contract registry over the page economy — ``pool.alloc``/
+    ``alloc_reserved``/``ref`` pair with ``deref``, ``reserve`` with
+    ``unreserve``, scheduler ``pop`` with ``release``, prefix-trie
+    ``acquire`` with post-splice ``release`` — checked per function over
+    an exception-edge-aware walk: discarded acquire results, ``raise``/
+    ``return`` edges between an acquire and the first handoff of the
+    bound value, and acquires for contracts with no matching release in
+    the scanned tree. ``if x is None``-guarded early exits and edges
+    covered by a try whose handler/finally rolls the acquire back are
+    exempt."""
+    findings: list[Finding] = []
+    scans: list[tuple[object, _FnScan]] = []    # (ModuleInfo, scan)
+    for mod in project.modules:
+        for fi in mod.functions:
+            if isinstance(fi.node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scans.append((mod, _FnScan(fi.node)))
+
+    released_anywhere = {c.name for _, s in scans for c in s.releases}
+
+    for mod, scan in scans:
+        for acq in scan.acquires:
+            c = acq.contract
+            if acq.discarded:
+                findings.append(Finding(
+                    mod.path, acq.line, PASS_ID, SEVERITY_ERROR,
+                    f"[{c.name}] result of {sorted(c.acquire)[0]}-family "
+                    "acquire is discarded — the resource leaks at birth",
+                    "bind the result and release it, or hand it off"))
+                continue
+            if not c.value:
+                if c.name not in released_anywhere:
+                    findings.append(Finding(
+                        mod.path, acq.line, PASS_ID, SEVERITY_ERROR,
+                        f"[{c.name}] counter acquire has no matching "
+                        f"{sorted(c.release)[0]} anywhere in the scanned "
+                        "tree",
+                        f"pair every {sorted(c.acquire)[0]} with "
+                        f"{sorted(c.release)[0]} on all paths"))
+                continue
+            if acq.discharged:
+                continue
+            if c.name not in released_anywhere:
+                findings.append(Finding(
+                    mod.path, acq.line, PASS_ID, SEVERITY_ERROR,
+                    f"[{c.name}] acquire but no {sorted(c.release)[0]} "
+                    "call anywhere in the scanned tree",
+                    "release the resource or hand ownership off"))
+                continue
+            discharge = min(
+                (ln for (n, ln) in scan.loads
+                 if n in acq.bound and ln > acq.line), default=_INF)
+            for e in scan.edges:
+                if not (acq.line < e.line < discharge):
+                    continue
+                if e.guards & acq.bound:
+                    continue    # `if x is None: return` — nothing acquired
+                if c.name in e.cleanup:
+                    continue    # try-with-rollback covers this edge
+                findings.append(Finding(
+                    mod.path, e.line, PASS_ID, SEVERITY_ERROR,
+                    f"[{c.name}] {e.kind} edge leaks the value acquired "
+                    f"at line {acq.line} ({'/'.join(sorted(acq.bound))}) "
+                    "before it is released or handed off",
+                    f"release via {sorted(c.release)[0]} on this path "
+                    "(try/except rollback) or hand ownership off first"))
+            if discharge == _INF:
+                findings.append(Finding(
+                    mod.path, acq.line, PASS_ID, SEVERITY_WARNING,
+                    f"[{c.name}] acquired value "
+                    f"({'/'.join(sorted(acq.bound))}) is never used, "
+                    "released, or handed off before function exit",
+                    f"release via {sorted(c.release)[0]} or remove the "
+                    "acquire"))
+    return findings

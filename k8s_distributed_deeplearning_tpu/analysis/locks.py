@@ -1,0 +1,494 @@
+"""graftlint pass 7: lock discipline over the threaded serving stack.
+
+The serving tree is full of classes that own a ``threading.Lock`` /
+``threading.Condition`` and a second thread (transport's step loop,
+exporter handler threads, injector fire hooks). Nothing in Python makes
+"this attribute is only touched under that lock" checkable — so this
+pass infers it per class and then audits the three race shapes that have
+actually bitten the stack:
+
+(a) **unguarded access to guarded state** — an attribute written mostly
+    under ``with self._lock:`` is *guarded*; reading or writing it with
+    no lock held, in a method reachable from a thread entry point
+    (``Thread(target=self.m)``, an escaping bound-method reference, an
+    HTTP ``do_*`` handler, an injector ``_on_fault`` hook), is a data
+    race.
+(b) **blocking call under a held lock** — socket/urllib I/O,
+    ``time.sleep``, subprocess spawns, jax dispatch, or an engine step
+    executed while holding a class lock stalls every other thread that
+    contends on it. An explicit ``.wait()``/``.wait_for()`` on the class's
+    own Condition is the sanctioned way to block and is exempt.
+(c) **inconsistent lock order** — class C calls into class D while
+    holding C's lock, and D calls back into C while holding D's lock:
+    the classic AB/BA deadlock, reported at both call sites.
+
+Exemptions: ``__init__`` bodies (construction happens-before thread
+start); attributes that *are* synchronization primitives (Lock/
+Condition/Event/Semaphore/Queue/``threading.local`` — self-guarded);
+accesses inside nested functions/lambdas (separate execution context,
+not attributed to the enclosing method); classes with no lock attribute
+at all (nothing to infer against).
+
+Suppress a deliberate violation with ``# graftlint:
+disable=lock-discipline`` plus an in-line justification — e.g.
+transport's single-lock design runs the engine step while holding
+``_cond`` on purpose.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+
+from k8s_distributed_deeplearning_tpu.analysis.core import (
+    Finding, ModuleInfo, SEVERITY_ERROR, SEVERITY_WARNING, dotted_name,
+    name_tail)
+
+PASS_ID = "lock-discipline"
+
+# Constructors whose result is a lock-like guard (with-able).
+_LOCK_CTORS = {"Lock": "lock", "RLock": "lock", "Condition": "condition"}
+# Constructors whose result is itself thread-safe — attributes holding
+# them are never "guarded state" and never need a lock to touch.
+_SYNC_CTORS = frozenset({
+    "Lock", "RLock", "Condition", "Event", "Semaphore", "BoundedSemaphore",
+    "Barrier", "Queue", "SimpleQueue", "LifoQueue", "PriorityQueue", "local",
+})
+# Fallback: `with self.X:` where X smells like a lock counts as a lock
+# region even when the constructor wasn't visible (e.g. injected locks).
+_LOCKISH = re.compile(r"lock|cond|mutex", re.IGNORECASE)
+
+# Method calls that mutate their receiver in place — a locked
+# `self._records.pop(k)` is evidence _records is guarded, same as a
+# locked `self._records[k] = v`.
+_MUTATORS = frozenset({
+    "append", "extend", "insert", "add", "update", "setdefault", "pop",
+    "popitem", "clear", "remove", "discard", "appendleft", "popleft",
+})
+
+_HTTP_HANDLERS = frozenset({
+    "do_GET", "do_POST", "do_PUT", "do_DELETE", "do_HEAD", "do_PATCH"})
+
+_SUBPROCESS_TAILS = frozenset({
+    "run", "Popen", "call", "check_call", "check_output"})
+_JAX_BLOCK_TAILS = frozenset({"block_until_ready", "device_get"})
+_SOCKET_TAILS = frozenset({"urlopen", "create_connection", "getaddrinfo"})
+
+
+def _self_attr(e: ast.expr) -> str | None:
+    """``self.X`` -> ``"X"``, else None."""
+    if (isinstance(e, ast.Attribute) and isinstance(e.value, ast.Name)
+            and e.value.id == "self"):
+        return e.attr
+    return None
+
+
+def _self_attr_base(e: ast.expr) -> str | None:
+    """Root ``self.X`` under subscript chains: ``self._tab[i]`` -> ``X``."""
+    while isinstance(e, ast.Subscript):
+        e = e.value
+    return _self_attr(e)
+
+
+@dataclasses.dataclass
+class _Access:
+    attr: str
+    line: int
+    held: frozenset[str]
+    is_write: bool
+
+
+@dataclasses.dataclass
+class _LockedCall:
+    call: ast.Call
+    held: frozenset[str]
+
+
+class _ClassScan:
+    """Everything pass 7 needs to know about one class definition."""
+
+    def __init__(self, mod: ModuleInfo, node: ast.ClassDef,
+                 parents: dict[ast.AST, ast.AST] | None = None):
+        self.mod = mod
+        self.node = node
+        self._parents = parents if parents is not None else mod.parent_map()
+        self.name = node.name
+        # Direct method children only — nested defs are separate scopes.
+        self.methods: dict[str, ast.FunctionDef] = {
+            st.name: st for st in node.body
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef))}
+        self.lock_attrs: dict[str, str] = {}     # attr -> "lock"|"condition"
+        self.sync_attrs: set[str] = set()
+        # self.X = ClassName(...) / self.X = <param annotated ClassName>
+        self.attr_class_tails: dict[str, str] = {}
+        self.has_fire_hook = False
+        self._scan_structure()
+        # method name -> [_Access]; method name -> [_LockedCall];
+        # method name -> set of self-method callees; escaping method refs.
+        self.accesses: dict[str, list[_Access]] = {}
+        self.locked_calls: dict[str, list[_LockedCall]] = {}
+        self.callees: dict[str, set[str]] = {}
+        self.entry_methods: set[str] = set()
+        self._scan_methods()
+        self.guarded: dict[str, frozenset[str]] = self._infer_guarded()
+
+    # -- structure ---------------------------------------------------
+
+    def _scan_structure(self) -> None:
+        for n in ast.walk(self.node):
+            if isinstance(n, ast.Call):
+                tail = name_tail(n.func)
+                if tail == "add_fire_hook" and any(
+                        isinstance(a, ast.Name) and a.id == "self"
+                        for a in n.args):
+                    self.has_fire_hook = True
+            if isinstance(n, (ast.With, ast.AsyncWith)):
+                for item in n.items:
+                    a = _self_attr(item.context_expr)
+                    if a and a not in self.lock_attrs and _LOCKISH.search(a):
+                        self.lock_attrs[a] = "lock"
+            if not isinstance(n, ast.Assign):
+                continue
+            attrs = [_self_attr(t) for t in n.targets]
+            attrs = [a for a in attrs if a]
+            if not attrs or not isinstance(n.value, ast.Call):
+                continue
+            tail = name_tail(n.value.func)
+            for a in attrs:
+                if tail in _LOCK_CTORS:
+                    self.lock_attrs[a] = _LOCK_CTORS[tail]
+                if tail in _SYNC_CTORS:
+                    self.sync_attrs.add(a)
+                elif tail and tail[0].isupper():
+                    self.attr_class_tails[a] = tail
+        # self.X = <param> with an annotated class type (composition via
+        # injection: `def attach(self, peer: "Gateway"): self.peer = peer`).
+        for fnode in self.methods.values():
+            ann = {}
+            for arg in (list(fnode.args.posonlyargs) + list(fnode.args.args)
+                        + list(fnode.args.kwonlyargs)):
+                if arg.annotation is None:
+                    continue
+                a = arg.annotation
+                if isinstance(a, ast.Constant) and isinstance(a.value, str):
+                    ann[arg.arg] = a.value.rsplit(".", 1)[-1]
+                else:
+                    t = name_tail(a)
+                    if t:
+                        ann[arg.arg] = t
+            if not ann:
+                continue
+            for st in ast.walk(fnode):
+                if (isinstance(st, ast.Assign)
+                        and isinstance(st.value, ast.Name)
+                        and st.value.id in ann):
+                    for t in st.targets:
+                        a = _self_attr(t)
+                        if a and a not in self.attr_class_tails:
+                            self.attr_class_tails[a] = ann[st.value.id]
+
+    # -- per-method walk with held-lock tracking ---------------------
+
+    def _scan_methods(self) -> None:
+        thread_bases = any(
+            (name_tail(b) or "").endswith("Thread") for b in self.node.bases)
+        handler_bases = any(
+            "RequestHandler" in (name_tail(b) or "") for b in self.node.bases)
+        for mname, fnode in self.methods.items():
+            acc: list[_Access] = []
+            calls: list[_LockedCall] = []
+            callees: set[str] = set()
+            self._visit_stmts(fnode.body, frozenset(), acc, calls, callees)
+            self.accesses[mname] = acc
+            self.locked_calls[mname] = calls
+            self.callees[mname] = callees
+            if mname in _HTTP_HANDLERS or (handler_bases
+                                           and mname.startswith("do_")):
+                self.entry_methods.add(mname)
+            if thread_bases and mname == "run":
+                self.entry_methods.add(mname)
+        if self.has_fire_hook and "_on_fault" in self.methods:
+            self.entry_methods.add("_on_fault")
+
+    def _visit_stmts(self, stmts, held, acc, calls, callees) -> None:
+        for st in stmts:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+                continue
+            if isinstance(st, (ast.With, ast.AsyncWith)):
+                acquired = set()
+                for item in st.items:
+                    self._visit_expr(item.context_expr, held, acc, calls,
+                                     callees)
+                    a = _self_attr(item.context_expr)
+                    if a and a in self.lock_attrs:
+                        acquired.add(a)
+                self._visit_stmts(st.body, frozenset(held | acquired),
+                                  acc, calls, callees)
+            elif isinstance(st, ast.If):
+                self._visit_expr(st.test, held, acc, calls, callees)
+                self._visit_stmts(st.body, held, acc, calls, callees)
+                self._visit_stmts(st.orelse, held, acc, calls, callees)
+            elif isinstance(st, (ast.For, ast.AsyncFor)):
+                self._visit_expr(st.target, held, acc, calls, callees)
+                self._visit_expr(st.iter, held, acc, calls, callees)
+                self._visit_stmts(st.body, held, acc, calls, callees)
+                self._visit_stmts(st.orelse, held, acc, calls, callees)
+            elif isinstance(st, ast.While):
+                self._visit_expr(st.test, held, acc, calls, callees)
+                self._visit_stmts(st.body, held, acc, calls, callees)
+                self._visit_stmts(st.orelse, held, acc, calls, callees)
+            elif isinstance(st, ast.Try):
+                self._visit_stmts(st.body, held, acc, calls, callees)
+                for h in st.handlers:
+                    self._visit_stmts(h.body, held, acc, calls, callees)
+                self._visit_stmts(st.orelse, held, acc, calls, callees)
+                self._visit_stmts(st.finalbody, held, acc, calls, callees)
+            else:
+                self._visit_expr(st, held, acc, calls, callees)
+
+    def _visit_expr(self, node, held, acc, calls, callees) -> None:
+        stack = [node]
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda, ast.ClassDef)):
+                continue
+            if isinstance(n, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                targets = (n.targets if isinstance(n, ast.Assign)
+                           else [n.target])
+                for t in targets:
+                    a = _self_attr_base(t)
+                    if a:
+                        acc.append(_Access(a, t.lineno, held, True))
+            elif isinstance(n, ast.Delete):
+                for t in n.targets:
+                    a = _self_attr_base(t)
+                    if a:
+                        acc.append(_Access(a, t.lineno, held, True))
+            elif isinstance(n, ast.Call):
+                calls.append(_LockedCall(n, held))
+                if isinstance(n.func, ast.Attribute):
+                    recv = _self_attr(n.func.value)
+                    if recv is not None and n.func.attr in _MUTATORS:
+                        acc.append(_Access(recv, n.lineno, held, True))
+                    m = _self_attr(n.func)
+                    if m is not None and m in self.methods:
+                        callees.add(m)
+            elif isinstance(n, ast.Attribute):
+                a = _self_attr(n)
+                if a is not None:
+                    if isinstance(n.ctx, ast.Load):
+                        acc.append(_Access(a, n.lineno, held, False))
+                    # Escaping bound-method reference: self.m used anywhere
+                    # but as the func of a direct call -> thread entry.
+                    if a in self.methods and not self._is_call_func(n):
+                        self.entry_methods.add(a)
+            stack.extend(ast.iter_child_nodes(n))
+
+    def _is_call_func(self, attr_node: ast.Attribute) -> bool:
+        parent = self._parents.get(attr_node)
+        return isinstance(parent, ast.Call) and parent.func is attr_node
+
+    # -- guarded inference -------------------------------------------
+
+    def _infer_guarded(self) -> dict[str, frozenset[str]]:
+        writes: dict[str, list[_Access]] = {}
+        for mname, acc in self.accesses.items():
+            if mname in ("__init__", "__del__"):
+                continue
+            for a in acc:
+                if a.is_write:
+                    writes.setdefault(a.attr, []).append(a)
+        guarded: dict[str, frozenset[str]] = {}
+        for attr, ws in writes.items():
+            if attr in self.lock_attrs or attr in self.sync_attrs:
+                continue
+            locked = [w for w in ws if w.held]
+            if locked and len(locked) * 2 >= len(ws):
+                guards: set[str] = set()
+                for w in locked:
+                    guards |= set(w.held)
+                guarded[attr] = frozenset(guards)
+        return guarded
+
+    def reachable_from_entries(self) -> set[str]:
+        seen: set[str] = set()
+        work = [m for m in self.entry_methods if m in self.methods]
+        while work:
+            m = work.pop()
+            if m in seen:
+                continue
+            seen.add(m)
+            work.extend(c for c in self.callees.get(m, ()) if c not in seen)
+        return seen
+
+    def methods_acquiring_locks(self) -> set[str]:
+        out = set()
+        for mname, acc in self.accesses.items():
+            if any(a.held for a in acc):
+                out.add(mname)
+                continue
+            if any(c.held for c in self.locked_calls.get(mname, ())):
+                out.add(mname)
+        # A method whose body is just `with self._lock: pass` has neither
+        # accesses nor calls; detect the With directly.
+        for mname, fnode in self.methods.items():
+            if mname in out:
+                continue
+            for n in ast.walk(fnode):
+                if isinstance(n, (ast.With, ast.AsyncWith)):
+                    for item in n.items:
+                        a = _self_attr(item.context_expr)
+                        if a in self.lock_attrs:
+                            out.add(mname)
+        return out
+
+
+def _blocking_reason(call: ast.Call, scan: _ClassScan) -> str | None:
+    """A human-readable reason when *call* can block, else None."""
+    fn = call.func
+    dn = dotted_name(fn) or ""
+    tail = name_tail(fn) or ""
+    if dn in ("time.sleep", "os.system"):
+        return dn
+    if dn.startswith(("urllib.", "socket.", "requests.")):
+        return f"network I/O ({dn})"
+    if tail in _SOCKET_TAILS:
+        return f"network I/O ({tail})"
+    head = dn.split(".", 1)[0] if "." in dn else ""
+    if head == "subprocess" and tail in _SUBPROCESS_TAILS:
+        return f"subprocess ({dn})"
+    if head == "jax" or tail in _JAX_BLOCK_TAILS:
+        return f"jax dispatch ({dn or tail})"
+    if isinstance(fn, ast.Attribute):
+        recv_tail = name_tail(fn.value) or ""
+        if fn.attr == "wait" and _self_attr(fn.value) not in scan.lock_attrs \
+                and recv_tail != "self":
+            return f"blocking wait ({recv_tail}.wait)"
+        if fn.attr == "step" and "engine" in recv_tail.lower():
+            return f"engine dispatch ({recv_tail}.step())"
+        if fn.attr in ("accept", "recv", "recvfrom", "sendall", "connect") \
+                and "sock" in recv_tail.lower():
+            return f"socket I/O ({recv_tail}.{fn.attr})"
+    return None
+
+
+def _scan_classes(project) -> list[_ClassScan]:
+    scans = []
+    for mod in project.modules:
+        parents = project.parents(mod)
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ClassDef):
+                scans.append(_ClassScan(mod, node, parents))
+    return scans
+
+
+def pass_lock_discipline(project) -> list[Finding]:
+    """Per-class guarded-attribute inference (written mostly under ``with
+    self._lock`` => guarded) then three checks: (a) guarded state touched
+    with no lock held in methods reachable from a thread entry point
+    (``Thread(target=...)``/escaping bound methods, HTTP ``do_*``
+    handlers, injector ``_on_fault`` hooks); (b) blocking calls —
+    socket/urllib I/O, ``time.sleep``, subprocess, jax dispatch, engine
+    steps — made while holding a class lock, except explicit condition
+    ``.wait()``/``.wait_for()``; (c) lock-order inversion between classes
+    holding references to each other (AB/BA deadlock), reported at both
+    call sites. ``__init__`` and sync-primitive attributes are exempt;
+    nested functions are separate contexts."""
+    findings: list[Finding] = []
+    scans = _scan_classes(project)
+    by_name: dict[str, _ClassScan] = {}
+    for s in scans:
+        # Last definition wins; class-name collisions across the tree are
+        # rare and only soften check (c).
+        by_name[s.name] = s
+
+    for scan in scans:
+        if not scan.lock_attrs:
+            continue
+        # (a) unguarded access to guarded state from a thread entry point.
+        reachable = scan.reachable_from_entries()
+        seen: set[tuple[int, str]] = set()
+        for mname in sorted(reachable):
+            if mname == "__init__":
+                continue
+            for a in scan.accesses.get(mname, ()):
+                guards = scan.guarded.get(a.attr)
+                if not guards or a.held & guards:
+                    continue
+                key = (a.line, a.attr)
+                if key in seen:
+                    continue
+                seen.add(key)
+                lock = sorted(guards)[0]
+                kind = "write to" if a.is_write else "read of"
+                findings.append(Finding(
+                    scan.mod.path, a.line, PASS_ID, SEVERITY_ERROR,
+                    f"{scan.name}.{mname}: unguarded {kind} "
+                    f"{a.attr!r}, which is written under self.{lock} "
+                    f"elsewhere and reachable from a thread entry point",
+                    f"take `with self.{lock}:` around the access or "
+                    "suppress with a justification if the race is benign"))
+        # (b) blocking calls under a held lock.
+        for mname, calls in scan.locked_calls.items():
+            for lc in calls:
+                if not lc.held:
+                    continue
+                fn = lc.call.func
+                if isinstance(fn, ast.Attribute) and fn.attr in (
+                        "wait", "wait_for", "notify", "notify_all"):
+                    a = _self_attr(fn.value)
+                    if a in scan.lock_attrs:
+                        continue    # sanctioned condition wait/notify
+                reason = _blocking_reason(lc.call, scan)
+                if reason is None:
+                    continue
+                lock = sorted(lc.held)[0]
+                findings.append(Finding(
+                    scan.mod.path, lc.call.lineno, PASS_ID, SEVERITY_ERROR,
+                    f"{scan.name}.{mname}: blocking call ({reason}) while "
+                    f"holding self.{lock}",
+                    "move the blocking work outside the lock region, or "
+                    "suppress with a justification if serialization is "
+                    "the design"))
+
+    # (c) lock-order inversion across mutually-referencing classes.
+    edges: dict[tuple[str, str], list[tuple[str, int, str]]] = {}
+    for scan in scans:
+        if not scan.lock_attrs:
+            continue
+        acquiring: dict[str, set[str]] = {}
+        for mname, calls in scan.locked_calls.items():
+            for lc in calls:
+                if not lc.held:
+                    continue
+                fn = lc.call.func
+                if not isinstance(fn, ast.Attribute):
+                    continue
+                recv = _self_attr(fn.value)
+                if recv is None or recv not in scan.attr_class_tails:
+                    continue
+                other = by_name.get(scan.attr_class_tails[recv])
+                if other is None or other is scan or not other.lock_attrs:
+                    continue
+                acq = acquiring.get(other.name)
+                if acq is None:
+                    acq = acquiring[other.name] = \
+                        other.methods_acquiring_locks()
+                if fn.attr not in acq:
+                    continue
+                edges.setdefault((scan.name, other.name), []).append(
+                    (scan.mod.path, lc.call.lineno, mname))
+    for (c, d), sites in sorted(edges.items()):
+        if (d, c) not in edges or c > d:
+            continue    # need both directions; report the pair once
+        for path, line, mname in sites + edges[(d, c)]:
+            findings.append(Finding(
+                path, line, PASS_ID, SEVERITY_WARNING,
+                f"lock-order inversion risk: {c} and {d} each call into "
+                f"the other while holding their own lock "
+                f"(site in {mname})",
+                "establish a single acquisition order or drop the lock "
+                "before crossing the object boundary"))
+    return findings

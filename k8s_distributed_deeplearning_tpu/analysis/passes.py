@@ -1,4 +1,4 @@
-"""The graftlint passes: six hazard classes, one walker, zero imports of jax.
+"""The graftlint passes: eight hazard classes, one walker, zero imports of jax.
 
 Every pass is a function ``(Project) -> list[Finding]`` registered in
 :data:`PASSES`. A pass reports everything it sees — suppression filtering
@@ -21,6 +21,10 @@ from typing import Callable
 from k8s_distributed_deeplearning_tpu.analysis.core import (
     Finding, ModuleInfo, SEVERITY_ERROR, SEVERITY_WARNING, Taint,
     dotted_name, load_modules, name_tail, str_constants)
+from k8s_distributed_deeplearning_tpu.analysis.lifecycle import (
+    pass_resource_lifecycle)
+from k8s_distributed_deeplearning_tpu.analysis.locks import (
+    pass_lock_discipline)
 
 # ----------------------------------------------------------------- project
 
@@ -724,6 +728,13 @@ PASSES: tuple[PassSpec, ...] = (
     PassSpec("fault-site",
              "fault hook sites vs faults/plan.py SITES table, both "
              "directions", pass_fault_site),
+    PassSpec("lock-discipline",
+             "guarded-attribute inference then cross-thread unguarded "
+             "access, blocking-under-lock, and lock-order inversion",
+             pass_lock_discipline),
+    PassSpec("resource-lifecycle",
+             "pool page/reservation, scheduler slot-quota, and trie-pin "
+             "pairing over exception edges", pass_resource_lifecycle),
 )
 
 PASS_IDS = tuple(p.id for p in PASSES)

@@ -1375,27 +1375,39 @@ class ServeEngine:
                 f"pool has {len(leaves)} — different model geometry")
         pages = self.pool.alloc(nb, owner="imported")
         self.pool.reserve(grow)
-        nbp = _page_bucket(nb)
-        idx = np.zeros(nbp, np.int32)
-        idx[:nb] = pages
-        idx = jnp.asarray(idx)
-        new_leaves = []
-        nbytes = 0
-        for leaf, vals in zip(leaves, staged):
-            vals = np.asarray(vals)
-            want = leaf.shape[:-3] + (nb,) + leaf.shape[-2:]
-            if vals.shape != want:
-                raise ValueError(
-                    f"staged leaf shape {vals.shape} != expected {want} "
-                    "— different model geometry")
-            nbytes += vals.nbytes
-            if nbp != nb:
-                pad = np.zeros(vals.shape[:-3] + (nbp - nb,)
-                               + vals.shape[-2:], vals.dtype)
-                vals = np.concatenate([vals, pad], axis=-3)
-            new_leaves.append(_scatter_pages_program(
-                leaf, jnp.asarray(vals, leaf.dtype), idx))
-        self._cache = jax.tree_util.tree_unflatten(treedef, new_leaves)
+        try:
+            nbp = _page_bucket(nb)
+            idx = np.zeros(nbp, np.int32)
+            idx[:nb] = pages
+            idx = jnp.asarray(idx)
+            new_leaves = []
+            nbytes = 0
+            for leaf, vals in zip(leaves, staged):
+                vals = np.asarray(vals)
+                want = leaf.shape[:-3] + (nb,) + leaf.shape[-2:]
+                if vals.shape != want:
+                    raise ValueError(
+                        f"staged leaf shape {vals.shape} != expected {want} "
+                        "— different model geometry")
+                nbytes += vals.nbytes
+                if nbp != nb:
+                    pad = np.zeros(vals.shape[:-3] + (nbp - nb,)
+                                   + vals.shape[-2:], vals.dtype)
+                    vals = np.concatenate([vals, pad], axis=-3)
+                new_leaves.append(_scatter_pages_program(
+                    leaf, jnp.asarray(vals, leaf.dtype), idx))
+            self._cache = jax.tree_util.tree_unflatten(treedef, new_leaves)
+        except Exception:
+            # Roll the allocation back before re-raising: a geometry
+            # mismatch (or a failed scatter) answers the caller with an
+            # error while this engine keeps serving — without this, the
+            # freshly alloc'd pages and growth reservation leaked on
+            # every rejected import (transport maps ValueError to a 400
+            # and carries on).
+            for p in pages:
+                self.pool.deref(int(p))
+            self.pool.unreserve(grow)
+            raise
         row = self._tables[slot]
         row[:] = 0
         row[:nb] = pages

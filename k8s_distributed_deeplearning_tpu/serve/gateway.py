@@ -64,6 +64,7 @@ greedy stream is bit-identical to an unfaulted single-replica run.
 from __future__ import annotations
 
 import dataclasses
+import threading
 import time
 from collections import deque
 from typing import Callable, Iterable, Sequence
@@ -222,6 +223,12 @@ class ServeGateway:
         if flight is not None:
             _faults.add_fire_hook(self)
         self._clock = clock
+        # Guards the membership structures (_replicas/_by_rid/_next_index)
+        # only: the injector's fire hook and the exporter's collector
+        # threads read them mid-step via _flight_extra/snapshot while the
+        # main thread adds/removes replicas. Engine calls stay OUTSIDE
+        # the lock — membership is copied under it, then inspected.
+        self._lock = threading.Lock()
         self._replicas: list[_Replica] = []
         self._by_rid: dict[str, _Replica] = {}
         for i, eng in enumerate(replicas):
@@ -375,20 +382,22 @@ class ServeGateway:
         the replica id. Raises ValueError on a duplicate id."""
         if rid is None:
             rid = getattr(engine, "replica_id", None)
-        index = self._next_index
-        if rid is None:
-            rid = f"r{index}"
+        with self._lock:
+            index = self._next_index
+            if rid is None:
+                rid = f"r{index}"
+            if rid in self._by_rid:
+                raise ValueError(f"duplicate replica_id {rid!r}")
+            self._next_index += 1
+            h = _Replica(engine, rid, index, self.probe_backoff_s)
+            self._replicas.append(h)
+            self._by_rid[rid] = h
+            n = len(self._replicas)
         if getattr(engine, "replica_id", None) is None:
             engine.replica_id = rid       # request_trace replica= field
-        if rid in self._by_rid:
-            raise ValueError(f"duplicate replica_id {rid!r}")
-        self._next_index += 1
-        h = _Replica(engine, rid, index, self.probe_backoff_s)
-        self._replicas.append(h)
-        self._by_rid[rid] = h
         if self.logger is not None:
             self.logger.emit("gateway_replica_added", replica=rid,
-                             replicas=len(self._replicas))
+                             replicas=n)
         return rid
 
     def remove_replica(self, rid: str, *, force: bool = False) -> None:
@@ -399,25 +408,28 @@ class ServeGateway:
         last replica, and RuntimeError if the engine has not finished
         draining yet (call again after more steps; ``force=True`` skips
         both the last-replica and the drained checks — shutdown paths)."""
-        h = self._by_rid.get(rid)
-        if h is None:
-            raise ValueError(
-                f"unknown replica {rid!r} (have {sorted(self._by_rid)})")
-        if len(self._replicas) <= 1 and not force:
-            raise ValueError(
-                f"refusing to remove the last replica {rid!r} "
-                f"(force=True to tear the gateway down)")
+        with self._lock:
+            h = self._by_rid.get(rid)
+            if h is None:
+                raise ValueError(
+                    f"unknown replica {rid!r} (have {sorted(self._by_rid)})")
+            if len(self._replicas) <= 1 and not force:
+                raise ValueError(
+                    f"refusing to remove the last replica {rid!r} "
+                    f"(force=True to tear the gateway down)")
         if not h.draining:
             self.drain_replica(rid)
         if not h.engine.drained and not force:
             raise RuntimeError(
                 f"replica {rid!r} is still draining — step the gateway "
                 f"until its engine reports drained, then remove")
-        self._replicas.remove(h)
-        del self._by_rid[rid]
+        with self._lock:
+            self._replicas.remove(h)
+            del self._by_rid[rid]
+            n = len(self._replicas)
         if self.logger is not None:
             self.logger.emit("gateway_replica_removed", replica=rid,
-                             replicas=len(self._replicas))
+                             replicas=n)
 
     def replica_engine(self, rid: str):
         """The engine behind *rid* (autoscale backends stop it after the
@@ -489,8 +501,10 @@ class ServeGateway:
         """Point-in-time gateway view: the bridge's ``gateway_collector``
         and the CLI summary read this."""
         now = self._clock()
+        with self._lock:
+            members = list(self._replicas)
         replicas = {}
-        for h in self._replicas:
+        for h in members:
             replicas[h.rid] = {
                 "state": h.state,
                 "consecutive_failures": h.consecutive,
@@ -677,8 +691,10 @@ class ServeGateway:
         state plus — when a specific replica is dying — its reason and
         its pool's page ledger. getattr-guarded so stub engines/pools
         (tests) without the ledger surface still dump cleanly."""
+        with self._lock:
+            members = list(self._replicas)
         extra: dict = {
-            "breakers": {r.rid: r.state for r in self._replicas},
+            "breakers": {r.rid: r.state for r in members},
             "live_requests": len(self._live),
         }
         if h is not None:

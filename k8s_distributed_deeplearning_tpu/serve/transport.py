@@ -287,16 +287,18 @@ class ReplicaServer:
     # ------------------------------------------------------------- probes
 
     def _healthz(self) -> dict:
-        if self._step_error is not None:
-            raise RuntimeError(f"step loop died: {self._step_error}")
-        return {"draining": self.engine.draining,
-                "drained": self.engine.drained,
-                "steps": self._steps}
+        with self._cond:
+            if self._step_error is not None:
+                raise RuntimeError(f"step loop died: {self._step_error}")
+            return {"draining": self.engine.draining,
+                    "drained": self.engine.drained,
+                    "steps": self._steps}
 
     def _readyz(self) -> dict:
-        return {"ready": self._step_error is None
-                and not self.engine.draining,
-                "draining": self.engine.draining}
+        with self._cond:
+            return {"ready": self._step_error is None
+                    and not self.engine.draining,
+                    "draining": self.engine.draining}
 
     # ----------------------------------------------------------- handlers
 
@@ -533,6 +535,12 @@ class ReplicaServer:
             with self._cond:
                 if self.engine.busy():
                     try:
+                        # Stepping while holding _cond is the single-lock
+                        # design: the engine is not thread-safe, so ALL
+                        # access — handlers included — serializes on this
+                        # one lock, and the loop yields it via the
+                        # condition wait whenever the engine goes idle.
+                        # graftlint: disable=lock-discipline
                         self.engine.step()
                         self._steps += 1
                     except Exception as e:   # noqa: BLE001 — the loop is
@@ -551,7 +559,9 @@ class ReplicaServer:
         now = time.monotonic()
         if force or now - self._hb_last >= self._hb_interval:
             self._hb_last = now
-            self._hb.beat(step=self._steps, metrics_addr=self.address,
+            with self._cond:
+                steps = self._steps
+            self._hb.beat(step=steps, metrics_addr=self.address,
                           role=self.role)
 
     def serve_forever(self, poll_s: float = 0.05) -> None:

@@ -14,8 +14,10 @@ train step via ``pmean`` (parity: ``MetricAverageCallback``,
 """
 from __future__ import annotations
 
+import functools
 import json
 import sys
+import threading
 import time
 from typing import Any, IO
 
@@ -86,6 +88,20 @@ class MetricsLogger:
             self._file = None
 
 
+def _locked(method):
+    """Run *method* under ``self._lock``. ServingStats is written by the
+    engine/gateway step path and read mid-step by exporter collector
+    threads (``summary()``, the bridge's per-counter reads); the RLock
+    makes each record/summary atomic — RLock, not Lock, because
+    ``summary()`` reads the ``total_tokens`` property, which takes the
+    lock again on the same thread."""
+    @functools.wraps(method)
+    def wrapper(self, *args, **kwargs):
+        with self._lock:
+            return method(self, *args, **kwargs)
+    return wrapper
+
+
 class ServingStats:
     """Aggregates the serving engine's per-iteration observations into the
     quantities a capacity planner actually reads: aggregate tokens/sec,
@@ -100,6 +116,7 @@ class ServingStats:
     """
 
     def __init__(self):
+        self._lock = threading.RLock()
         self.t_start: float | None = None
         self.t_last: float | None = None
         self.steps = 0
@@ -181,16 +198,19 @@ class ServingStats:
             self.t_start = now
         self.t_last = now
 
+    @_locked
     def record_admission(self, queue_s: float, prompt_len: int) -> None:
         self._tick()
         self.admitted += 1
         self.prompt_tokens += prompt_len
         self.queue_s.append(queue_s)
 
+    @_locked
     def record_first_token(self, ttft_s: float) -> None:
         self._tick()
         self.ttft_s.append(ttft_s)
 
+    @_locked
     def record_step(self, active_slots: int, num_slots: int,
                     tokens: int | None = None) -> None:
         """One decode iteration. ``tokens`` overrides the emitted-token
@@ -202,6 +222,7 @@ class ServingStats:
         self.decode_tokens += active_slots if tokens is None else int(tokens)
         self.occupancy_sum += active_slots / max(num_slots, 1)
 
+    @_locked
     def record_spec_step(self, proposed: int,
                          accepted_counts: "list[int] | tuple[int, ...]"
                          ) -> None:
@@ -217,6 +238,7 @@ class ServingStats:
             self.spec_accepted_tokens += a
             self.spec_accept_hist[a] = self.spec_accept_hist.get(a, 0) + 1
 
+    @_locked
     def record_prefix_lookup(self, hit_tokens: int,
                              prompt_tokens: int) -> None:
         """One prefix-cache lookup at admission: ``hit_tokens`` of the
@@ -229,15 +251,18 @@ class ServingStats:
         self.prefix_hit_tokens += hit_tokens
         self.prefix_lookup_tokens += prompt_tokens
 
+    @_locked
     def record_prefix_evictions(self, n_blocks: int) -> None:
         self._tick()
         self.prefix_evictions += n_blocks
 
+    @_locked
     def record_request_trace(self) -> None:
         """One sampled ``request_trace`` lifecycle event was emitted."""
         self._tick()
         self.request_traces += 1
 
+    @_locked
     def record_kv_pool(self, pages_total: int, pages_used: int,
                        pages_shared: int,
                        by_owner: dict | None = None) -> None:
@@ -252,29 +277,34 @@ class ServingStats:
         if by_owner is not None:
             self.kv_pages_by_owner = {k: int(v) for k, v in by_owner.items()}
 
+    @_locked
     def record_gateway_dispatch(self) -> None:
         """One gateway request dispatch (first placement, a migration
         resubmit, or a hedge) landed on a replica."""
         self._tick()
         self.gateway_dispatches += 1
 
+    @_locked
     def record_gateway_migration(self) -> None:
         """One live request was migrated off a tripped/draining replica
         and resubmitted (prompt + emitted tokens) to a healthy one."""
         self._tick()
         self.gateway_migrations += 1
 
+    @_locked
     def record_gateway_hedge(self) -> None:
         """One speculative duplicate dispatch for a straggling prefill."""
         self._tick()
         self.gateway_hedges += 1
 
+    @_locked
     def record_gateway_breaker_trip(self) -> None:
         """One per-replica circuit breaker opened (consecutive dispatch
         failures or a failed half-open probe)."""
         self._tick()
         self.gateway_breaker_trips += 1
 
+    @_locked
     def record_gateway_poisoned(self) -> None:
         """One request quarantined: it exhausted the gateway's
         ``max_migrations`` budget (its replicas keep dying under it) and
@@ -282,12 +312,14 @@ class ServingStats:
         self._tick()
         self.gateway_poisoned += 1
 
+    @_locked
     def record_transport_retry(self) -> None:
         """One remote-replica transport call retried after a transient
         failure (connection error / timeout / injected network fault)."""
         self._tick()
         self.transport_retries += 1
 
+    @_locked
     def record_transport_dedup(self) -> None:
         """One retried submit was deduplicated by the replica server —
         the request had landed but its response was lost (the ambiguous
@@ -295,12 +327,14 @@ class ServingStats:
         self._tick()
         self.transport_dedup_hits += 1
 
+    @_locked
     def record_transport_reconnect(self) -> None:
         """One token stream resumed from its emitted-token cursor after
         one or more failed polls (exactly-once splice held)."""
         self._tick()
         self.transport_reconnects += 1
 
+    @_locked
     def record_disagg_export(self, pages: int, nbytes: int) -> None:
         """One request's KV pages were staged off this engine (prefill
         worker handoff, or live page-shipping migration)."""
@@ -308,6 +342,7 @@ class ServingStats:
         self.disagg_exports += 1
         self.disagg_bytes_shipped += int(nbytes)
 
+    @_locked
     def record_disagg_import(self, pages: int, nbytes: int) -> None:
         """One exported request was adopted into this engine's pool
         (pages tagged ``imported``) and resumed decoding."""
@@ -315,6 +350,7 @@ class ServingStats:
         self.disagg_imports += 1
         self.disagg_bytes_shipped += int(nbytes)
 
+    @_locked
     def record_disagg_fallback(self) -> None:
         """The coordinator routed one prompt down the unified decode-local
         prefill path because no prefill worker was healthy (or a shipped
@@ -322,12 +358,14 @@ class ServingStats:
         self._tick()
         self.disagg_fallbacks += 1
 
+    @_locked
     def record_disagg_depth(self, prefill: int, decode: int) -> None:
         """Latest per-role backlog snapshot (coordinator view). NO
         ``_tick()`` — a gauge refresh is not serving activity."""
         self.disagg_prefill_depth = int(prefill)
         self.disagg_decode_depth = int(decode)
 
+    @_locked
     def record_quant(self, kv_quant: str | None, weight_quant: str | None,
                      kv_bytes_saved: int, weight_bytes_saved: int) -> None:
         """Quantization configuration gauge, set once when the engine
@@ -338,6 +376,7 @@ class ServingStats:
         self.kv_quant_bytes_saved = int(kv_bytes_saved)
         self.weight_quant_bytes_saved = int(weight_bytes_saved)
 
+    @_locked
     def record_completion(self, latency_s: float, n_tokens: int,
                           reason: str) -> None:
         self._tick()
@@ -346,6 +385,7 @@ class ServingStats:
         self.finish_reasons[reason] = self.finish_reasons.get(reason, 0) + 1
 
     @property
+    @_locked
     def total_tokens(self) -> int:
         """Emitted tokens: one per admission + one per active slot-step."""
         return self.decode_tokens + len(self.ttft_s)
@@ -357,6 +397,7 @@ class ServingStats:
         s = sorted(xs)
         return s[min(len(s) - 1, int(round(q * (len(s) - 1))))]
 
+    @_locked
     def summary(self) -> dict:
         elapsed = ((self.t_last - self.t_start)
                    if self.t_start is not None and self.t_last is not None

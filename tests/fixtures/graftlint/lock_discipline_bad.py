@@ -1,0 +1,78 @@
+"""Positive fixture: every lock-discipline check fires here.
+
+(a) guarded state read without the lock from a thread entry point,
+(b) blocking calls (time.sleep, urllib) while holding the lock,
+(c) AB/BA lock-order inversion between mutually-referencing classes.
+"""
+import threading
+import time
+import urllib.request
+
+
+class StepServer:
+    """Checks (a) and (b): a step-loop thread guards ``_steps`` with
+    ``_lock``, an HTTP handler reads it bare, and the loop blocks while
+    holding the lock."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._steps = 0
+        self._last_error = None
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while True:
+            with self._lock:
+                self._steps += 1
+                time.sleep(0.01)          # (b) sleeping under the lock
+
+    def do_GET(self):
+        return {"steps": self._steps}     # (a) bare read off-thread
+
+    def record_error(self, e):
+        with self._lock:
+            self._last_error = repr(e)
+
+    def fetch_holding_lock(self, url):
+        with self._lock:
+            return urllib.request.urlopen(url)   # (b) I/O under the lock
+
+
+class Router:
+    """Check (c), one direction: push() holds Router's lock and calls
+    into Worker, whose accept() takes Worker's lock."""
+
+    def __init__(self, worker: "Worker"):
+        self._lock = threading.Lock()
+        self.worker = worker
+        self.pushed = 0
+
+    def push(self, item):
+        with self._lock:
+            self.pushed += 1
+            self.worker.accept(item)      # (c) Router lock -> Worker lock
+
+
+class Worker:
+    """Check (c), the other direction: flush() holds Worker's lock and
+    calls back into Router.push — the AB/BA inversion."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.router = None
+        self.items = []
+
+    def attach(self, router: "Router"):
+        self.router = router
+
+    def accept(self, item):
+        with self._lock:
+            self.items.append(item)
+
+    def flush(self):
+        with self._lock:
+            self.router.push(None)        # (c) Worker lock -> Router lock

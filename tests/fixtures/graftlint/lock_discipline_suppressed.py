@@ -1,0 +1,74 @@
+"""Suppressed twin of lock_discipline_bad.py — every finding carries a
+justified inline suppression, so the file lints clean."""
+import threading
+import time
+import urllib.request
+
+
+class StepServer:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._steps = 0
+        self._last_error = None
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while True:
+            with self._lock:
+                self._steps += 1
+                # graftlint: disable=lock-discipline — fixture: paces the
+                # loop on purpose; nothing else contends during the nap
+                time.sleep(0.01)
+
+    def do_GET(self):
+        # graftlint: disable=lock-discipline — fixture: stale int read is
+        # benign, the probe tolerates off-by-one
+        return {"steps": self._steps}
+
+    def record_error(self, e):
+        with self._lock:
+            self._last_error = repr(e)
+
+    def fetch_holding_lock(self, url):
+        with self._lock:
+            # graftlint: disable=lock-discipline — fixture: single-lock
+            # design, all access serializes here by contract
+            return urllib.request.urlopen(url)
+
+
+class Router:
+    def __init__(self, worker: "Worker"):
+        self._lock = threading.Lock()
+        self.worker = worker
+        self.pushed = 0
+
+    def push(self, item):
+        with self._lock:
+            self.pushed += 1
+            # graftlint: disable=lock-discipline — fixture: Worker never
+            # re-enters Router on this path at runtime
+            self.worker.accept(item)
+
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.router = None
+        self.items = []
+
+    def attach(self, router: "Router"):
+        self.router = router
+
+    def accept(self, item):
+        with self._lock:
+            self.items.append(item)
+
+    def flush(self):
+        with self._lock:
+            # graftlint: disable=lock-discipline — fixture: flush is only
+            # called from Router's own thread, the orders never interleave
+            self.router.push(None)

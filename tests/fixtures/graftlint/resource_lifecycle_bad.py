@@ -1,0 +1,50 @@
+"""Positive fixture: every resource-lifecycle check fires here.
+
+Exception-edge leaks for pool pages, scheduler slot quota, and trie
+pins; a discarded alloc result; and an unpaired reservation counter.
+The deref/release calls in the balanced paths keep the tree-wide
+"no release anywhere" rule from masking the per-edge checks.
+"""
+
+
+class Importer:
+    def __init__(self, pool, queue, prefix_cache):
+        self.pool = pool
+        self.queue = queue
+        self.prefix_cache = prefix_cache
+        self.table = []
+        self.closed = False
+
+    def leak_on_raise(self, n):
+        pages = self.pool.alloc(n)
+        if n > 8:
+            raise ValueError("too many pages")    # leaks `pages`
+        self.table.extend(pages)
+
+    def leak_on_return(self, n):
+        pages = self.pool.alloc(n)
+        if n % 2:
+            return None                           # leaks `pages`
+        self.table.extend(pages)
+
+    def discard_result(self):
+        self.pool.alloc(1)                        # result dropped: leak
+
+    def unpaired_reserve(self, n):
+        self.pool.reserve(n)                      # no unreserve anywhere
+
+    def pin_leak(self, tokens):
+        hit, nodes = self.prefix_cache.acquire(tokens)
+        if hit == 0:
+            raise LookupError("no prefix")        # leaks the pinned nodes
+        self.prefix_cache.release(nodes)
+        return hit
+
+    def quota_leak(self):
+        req = self.queue.pop()
+        if self.closed:
+            return None                           # leaks the slot quota
+        self.queue.release(req)
+
+    def balanced(self, page):
+        self.pool.deref(page)

@@ -1,0 +1,57 @@
+"""Suppressed twin of resource_lifecycle_bad.py — every finding carries
+a justified inline suppression, so the file lints clean."""
+
+
+class Importer:
+    def __init__(self, pool, queue, prefix_cache):
+        self.pool = pool
+        self.queue = queue
+        self.prefix_cache = prefix_cache
+        self.table = []
+        self.closed = False
+
+    def leak_on_raise(self, n):
+        pages = self.pool.alloc(n)
+        if n > 8:
+            # graftlint: disable=resource-lifecycle — fixture: caller
+            # tears the whole pool down on this error
+            raise ValueError("too many pages")
+        self.table.extend(pages)
+
+    def leak_on_return(self, n):
+        pages = self.pool.alloc(n)
+        if n % 2:
+            # graftlint: disable=resource-lifecycle — fixture: odd sizes
+            # park the pages for the next call by design
+            return None
+        self.table.extend(pages)
+
+    def discard_result(self):
+        # graftlint: disable=resource-lifecycle — fixture: warm-up alloc,
+        # the pool reclaims it on reset
+        self.pool.alloc(1)
+
+    def unpaired_reserve(self, n):
+        # graftlint: disable=resource-lifecycle — fixture: released by the
+        # teardown plane, not this module
+        self.pool.reserve(n)
+
+    def pin_leak(self, tokens):
+        hit, nodes = self.prefix_cache.acquire(tokens)
+        if hit == 0:
+            # graftlint: disable=resource-lifecycle — fixture: the trie
+            # unpins empty chains itself
+            raise LookupError("no prefix")
+        self.prefix_cache.release(nodes)
+        return hit
+
+    def quota_leak(self):
+        req = self.queue.pop()
+        if self.closed:
+            # graftlint: disable=resource-lifecycle — fixture: close()
+            # drains the quota ledger wholesale
+            return None
+        self.queue.release(req)
+
+    def balanced(self, page):
+        self.pool.deref(page)

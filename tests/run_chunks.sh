@@ -48,7 +48,14 @@ CHUNKS[paged]="tests/test_paged_kv.py"
 CHUNKS[faults]="tests/test_faults.py"
 # graftlint (pure-AST, no jax at analysis time): cheap, so it runs first —
 # a schema/axis/hot-path regression fails in seconds, not after compiles.
+# test_analysis.py auto-parametrizes its fixture matrix and CLI contract
+# over PASS_IDS, so the graftguard passes (lock-discipline and
+# resource-lifecycle, --changed/--explain/--json) run here too.
 CHUNKS[lint]="tests/test_analysis.py"
+# graftguard fix regressions (stats/gateway thread-safety races need real
+# threads; the import-rollback case compiles its own tiny model) — ride
+# with lint so the concurrency layer fails early as one unit.
+CHUNKS[guard]="tests/test_graftguard_fixes.py"
 # graftscope (telemetry analysis plane): mostly jax-free timeline/parser
 # tests plus engine-integration request-trace cases that compile their own
 # tiny model — split from serve so that chunk stays under its timeout.
@@ -99,7 +106,7 @@ CHUNKS[tp]="tests/test_tp_serve.py"
 CHUNKS[quant]="tests/test_quant.py"
 CHUNKS[slow1]="tests/test_train_e2e.py tests/test_multiprocess.py"
 CHUNKS[slow2]="tests/test_multihost_train.py tests/test_multihost_llama.py tests/test_train_zoo.py"
-ORDER=(lint core parallel1 parallel2 moe train llama deploy serve sched paged faults graftscope fleet gateway spec flight transport autoscale disagg storm tp quant slow1 slow2)
+ORDER=(lint guard core parallel1 parallel2 moe train llama deploy serve sched paged faults graftscope fleet gateway spec flight transport autoscale disagg storm tp quant slow1 slow2)
 
 # --- completeness check: every tests/test_*.py in EXACTLY one chunk ------
 # ...and every declared chunk actually in ORDER: a chunk missing from the

@@ -1,0 +1,244 @@
+"""Regression tests for the races and leaks the graftguard audit
+(graftlint passes 7-8) surfaced in the serving stack:
+
+* ``ServingStats`` counters are written by the engine/gateway step path
+  and read mid-step by exporter collector threads — now atomic under the
+  stats RLock (lost increments and dict-mutated-during-iteration crashes
+  before).
+* ``ServeGateway`` membership (``_replicas``/``_by_rid``) is mutated by
+  add/remove while the injector fire hook and exporter collectors read
+  it via ``_flight_extra``/``snapshot`` — now copied under the gateway
+  membership lock.
+* ``ServeEngine.import_request_kv`` leaked the freshly alloc'd pages and
+  growth reservation when a staged blob was rejected after allocation
+  (geometry mismatch) — now rolled back before the error propagates.
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+import pytest
+
+from k8s_distributed_deeplearning_tpu.utils.metrics import ServingStats
+
+
+# ------------------------------------------------------------ ServingStats
+
+def test_serving_stats_concurrent_records_are_atomic():
+    """N writer threads hammer the counters while a reader loops
+    summary(); every increment must land and no read may crash."""
+    stats = ServingStats()
+    n_threads, n_iters = 8, 400
+    stop = threading.Event()
+    errors: list[BaseException] = []
+
+    def writer():
+        try:
+            for i in range(n_iters):
+                stats.record_step(2, 4)
+                stats.record_admission(0.01, 5)
+                stats.record_completion(0.1, 3, "stop")
+                stats.record_spec_step(4, [1, 2])
+                stats.record_gateway_dispatch()
+        except BaseException as e:    # noqa: BLE001 — re-raised below
+            errors.append(e)
+
+    def reader():
+        try:
+            while not stop.is_set():
+                s = stats.summary()
+                # Internally consistent view: the dicts iterated while
+                # writers mutate them (the crash mode without the lock).
+                assert isinstance(s["finish_reasons"], dict)
+                assert isinstance(s["spec_accept_hist"], dict)
+                assert s["total_tokens"] >= 0
+        except BaseException as e:    # noqa: BLE001 — re-raised below
+            errors.append(e)
+
+    threads = [threading.Thread(target=writer) for _ in range(n_threads)]
+    threads.append(threading.Thread(target=reader))
+    for t in threads:
+        t.start()
+    for t in threads[:-1]:
+        t.join()
+    stop.set()
+    threads[-1].join()
+
+    assert not errors, errors
+    total = n_threads * n_iters
+    assert stats.steps == total
+    assert stats.decode_tokens == 2 * total
+    assert stats.admitted == total
+    assert stats.completed == total
+    assert stats.finish_reasons == {"stop": total}
+    assert stats.spec_steps == total
+    assert stats.spec_accepted_tokens == 3 * total
+    assert stats.spec_accept_hist == {1: total, 2: total}
+    assert stats.gateway_dispatches == total
+    assert len(stats.queue_s) == total and len(stats.latency_s) == total
+
+
+# ----------------------------------------------------- gateway membership
+
+class _StubPool:
+    def counters(self):
+        return {"pages_total": 8, "pages_used": 0, "pages_shared": 0}
+
+
+class _StubEngine:
+    """Minimal ServeEngine surface for membership churn: instant drain,
+    no jax."""
+
+    def __init__(self, replica_id=None):
+        self.replica_id = replica_id
+        self.queue = []
+        self.num_slots = 2
+        self.pool = _StubPool()
+        self._draining = False
+
+    def busy(self):
+        return False
+
+    def occupied_slots(self):
+        return 0
+
+    def load(self):
+        return 0
+
+    def step(self):
+        return []
+
+    def submit(self, req, *, requeue=False):
+        pass
+
+    def cancel(self, request_id, reason="aborted"):
+        return None
+
+    def drain(self, *, flush=False):
+        self._draining = True
+        return []
+
+    @property
+    def draining(self):
+        return self._draining
+
+    @property
+    def drained(self):
+        return self._draining
+
+    def shutdown(self):
+        return []
+
+
+def test_gateway_snapshot_during_membership_churn():
+    """Exporter-thread views (snapshot/_flight_extra) run concurrently
+    with add_replica/remove_replica; without copy-under-lock the list/
+    dict iterations crash with RuntimeError or skip entries."""
+    from k8s_distributed_deeplearning_tpu.serve.gateway import ServeGateway
+
+    gw = ServeGateway([_StubEngine("keep0"), _StubEngine("keep1")])
+    stop = threading.Event()
+    errors: list[BaseException] = []
+
+    def observer():
+        try:
+            while not stop.is_set():
+                snap = gw.snapshot()
+                assert isinstance(snap["replicas"], dict)
+                extra = gw._flight_extra()
+                assert isinstance(extra["breakers"], dict)
+        except BaseException as e:    # noqa: BLE001 — re-raised below
+            errors.append(e)
+
+    threads = [threading.Thread(target=observer) for _ in range(3)]
+    for t in threads:
+        t.start()
+    try:
+        for round_ in range(60):
+            rid = gw.add_replica(_StubEngine(), rid=f"churn{round_}")
+            gw.remove_replica(rid, force=True)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+    assert not errors, errors
+    assert sorted(gw.replica_ids()) == ["keep0", "keep1"]
+
+
+def test_gateway_add_remove_still_validate():
+    """The membership lock must not change the public error contract."""
+    from k8s_distributed_deeplearning_tpu.serve.gateway import ServeGateway
+
+    gw = ServeGateway([_StubEngine("only")])
+    with pytest.raises(ValueError, match="duplicate"):
+        gw.add_replica(_StubEngine(), rid="only")
+    with pytest.raises(ValueError, match="unknown replica"):
+        gw.remove_replica("ghost")
+    with pytest.raises(ValueError, match="last replica"):
+        gw.remove_replica("only")
+
+
+# ------------------------------------------- import_request_kv rollback
+
+@pytest.fixture(scope="module")
+def tiny():
+    import jax
+    import jax.numpy as jnp
+    from k8s_distributed_deeplearning_tpu.models import llama
+    cfg = llama.config_tiny(dtype=jnp.float32, max_seq_len=96)
+    model = llama.LlamaLM(cfg)
+    params = model.init(jax.random.key(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    return cfg, model, params
+
+
+def _export_one_blob(tiny, prompt):
+    from k8s_distributed_deeplearning_tpu.serve import Request, ServeEngine
+    _, model, params = tiny
+    src = ServeEngine(model, params, num_slots=2, eos_id=None,
+                      prefill_only=True)
+    src.submit(Request(prompt=list(prompt), max_new_tokens=8,
+                       request_id="leak0"))
+    blobs = []
+    while not blobs:
+        src.step()
+        blobs = src.take_exports()
+    return blobs[0]
+
+
+def test_import_rejected_after_alloc_rolls_back_pool(tiny):
+    """A blob whose staged leaves mismatch this engine's geometry is
+    rejected AFTER pages were alloc'd and growth reserved; the rollback
+    must return the pool to its pre-import state and leave the engine
+    serving (the leak graftlint's audit flagged)."""
+    from k8s_distributed_deeplearning_tpu.serve import ServeEngine
+    cfg, model, params = tiny
+    blob = _export_one_blob(tiny, [3, 4, 5, 6, 7, 8, 9, 10])
+    dst = ServeEngine(model, params, num_slots=2, eos_id=None)
+    before = dst.pool.counters()
+    assert before["pages_used"] == 0 and dst.pool.reserved == 0
+
+    bad = dict(blob)
+    # Keep the page count consistent but corrupt every staged leaf's
+    # shape: passes the leaf-count check, fails the per-leaf geometry
+    # check — the post-alloc raise path.
+    bad["pages"] = [np.asarray(v)[..., :1, :] for v in blob["pages"]]
+    with pytest.raises(ValueError, match="staged leaf shape"):
+        dst.import_request_kv(bad)
+
+    after = dst.pool.counters()
+    assert after["pages_used"] == 0, after
+    assert dst.pool.reserved == 0
+    assert dst.pool.available() == before["pages_total"]
+
+    # The engine is still healthy: the SAME pool covers a valid import
+    # and decodes to completion without leaking a page.
+    slot = dst.import_request_kv(blob)
+    assert slot >= 0
+    fin = []
+    while dst.busy():
+        fin.extend(dst.step())
+    assert fin and fin[0].finish_reason in ("length", "stop")
+    end = dst.pool.counters()
+    assert end["pages_used"] == 0 and dst.pool.reserved == 0
